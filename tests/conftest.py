@@ -1,0 +1,23 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Multi-chip hardware is not available in CI; sharding tests run on a virtual
+8-device CPU mesh (same XLA partitioner as real TPU). Must run before jax
+initializes, hence the env mutation at import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0x7B9)

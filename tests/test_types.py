@@ -1,0 +1,78 @@
+"""Binary layout and SoA round-trip tests (reference tigerbeetle.zig comptime
+size/padding asserts: Account/Transfer/AccountBalance 128 B, AccountFilter
+64 B, Create*Result 8 B)."""
+
+import numpy as np
+
+from tigerbeetle_tpu import types as t
+
+
+def test_sizes():
+    assert t.ACCOUNT_DTYPE.itemsize == 128
+    assert t.TRANSFER_DTYPE.itemsize == 128
+    assert t.ACCOUNT_BALANCE_DTYPE.itemsize == 128
+    assert t.ACCOUNT_FILTER_DTYPE.itemsize == 64
+    assert t.EVENT_RESULT_DTYPE.itemsize == 8
+
+
+def test_account_field_offsets():
+    # Offsets per the reference extern struct field order.
+    f = t.ACCOUNT_DTYPE.fields
+    assert f["id_lo"][1] == 0
+    assert f["debits_pending_lo"][1] == 16
+    assert f["debits_posted_lo"][1] == 32
+    assert f["credits_pending_lo"][1] == 48
+    assert f["credits_posted_lo"][1] == 64
+    assert f["user_data_128_lo"][1] == 80
+    assert f["user_data_64"][1] == 96
+    assert f["user_data_32"][1] == 104
+    assert f["reserved"][1] == 108
+    assert f["ledger"][1] == 112
+    assert f["code"][1] == 116
+    assert f["flags"][1] == 118
+    assert f["timestamp"][1] == 120
+
+
+def test_transfer_field_offsets():
+    f = t.TRANSFER_DTYPE.fields
+    assert f["id_lo"][1] == 0
+    assert f["debit_account_id_lo"][1] == 16
+    assert f["credit_account_id_lo"][1] == 32
+    assert f["amount_lo"][1] == 48
+    assert f["pending_id_lo"][1] == 64
+    assert f["user_data_128_lo"][1] == 80
+    assert f["user_data_64"][1] == 96
+    assert f["user_data_32"][1] == 104
+    assert f["timeout"][1] == 108
+    assert f["ledger"][1] == 112
+    assert f["code"][1] == 116
+    assert f["flags"][1] == 118
+    assert f["timestamp"][1] == 120
+
+
+def test_u128_split_roundtrip():
+    big = (0xDEADBEEF << 64) | 0xCAFEBABE12345678
+    rec = t.transfer(id=big, amount=t.U128_MAX, debit_account_id=1, credit_account_id=2)
+    assert t.u128_of(rec, "id") == big
+    assert t.u128_of(rec, "amount") == t.U128_MAX
+    raw = rec.tobytes()
+    assert len(raw) == 128
+    assert raw[:16] == big.to_bytes(16, "little")
+
+
+def test_soa_roundtrip(rng):
+    n = 17
+    recs = np.zeros(n, dtype=t.TRANSFER_DTYPE)
+    for name in recs.dtype.names:
+        info = recs.dtype.fields[name][0]
+        recs[name] = rng.integers(0, np.iinfo(info).max, size=n, dtype=info)
+    soa = t.transfers_to_soa(recs)
+    lo, hi = t.limbs_to_u64_pair(soa["id"])
+    assert np.array_equal(lo, recs["id_lo"]) and np.array_equal(hi, recs["id_hi"])
+    assert np.array_equal(t.limbs_to_u64(soa["timestamp"]), recs["timestamp"])
+    assert soa["amount"].shape == (n, 4) and soa["amount"].dtype == np.uint32
+
+
+def test_limb_int_roundtrip():
+    for v in [0, 1, (1 << 128) - 1, 0x0123456789ABCDEF_FEDCBA9876543210]:
+        assert t.limbs_to_int(t.int_to_limbs(v)) == v

@@ -1,0 +1,88 @@
+"""Limb arithmetic vs Python bigints (the reference semantics are Zig u128
+ops with explicit overflow checks, /root/reference/src/state_machine.zig:1645
+sum_overflows, :1286-1306 saturating clamps)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu.ops import u128 as w
+from tigerbeetle_tpu.types import int_to_limbs
+
+U128_MAX = (1 << 128) - 1
+
+EDGE = [
+    0, 1, 2, 3, 0xFFFFFFFF, 1 << 32, (1 << 32) + 1, (1 << 64) - 1, 1 << 64,
+    (1 << 64) + 1, (1 << 96) - 1, 1 << 96, U128_MAX - 1, U128_MAX,
+]
+
+
+def rand_u128(rng, n):
+    # Mix uniform-bit-width values so carries at every limb boundary get hit.
+    bits = rng.integers(0, 129, size=n)
+    vals = []
+    for b in bits:
+        vals.append(int(rng.integers(0, 1 << 30)) if b == 0 else rng.integers(0, 1 << 62).item() % (1 << int(b)))
+    return vals
+
+
+def pairs(rng, n=256):
+    a = rand_u128(rng, n) + EDGE
+    b = EDGE + rand_u128(rng, n)
+    return a, b
+
+
+def to_limb_array(vals, width=4):
+    return jnp.asarray(np.stack([int_to_limbs(v, width) for v in vals]))
+
+
+@pytest.mark.parametrize("width", [2, 4])
+def test_add_sub_cmp(rng, width):
+    mod = 1 << (32 * width)
+    a_i, b_i = pairs(rng)
+    a_i = [v % mod for v in a_i]
+    b_i = [v % mod for v in b_i]
+    a = to_limb_array(a_i, width)
+    b = to_limb_array(b_i, width)
+
+    s, over = jax.jit(w.add)(a, b)
+    assert w.to_ints(s) == [(x + y) % mod for x, y in zip(a_i, b_i)]
+    assert list(np.asarray(over)) == [x + y >= mod for x, y in zip(a_i, b_i)]
+
+    d, under = jax.jit(w.sub)(a, b)
+    assert w.to_ints(d) == [(x - y) % mod for x, y in zip(a_i, b_i)]
+    assert list(np.asarray(under)) == [x < y for x, y in zip(a_i, b_i)]
+
+    assert list(np.asarray(w.lt(a, b))) == [x < y for x, y in zip(a_i, b_i)]
+    assert list(np.asarray(w.le(a, b))) == [x <= y for x, y in zip(a_i, b_i)]
+    assert list(np.asarray(w.eq(a, b))) == [x == y for x, y in zip(a_i, b_i)]
+    assert w.to_ints(w.min_(a, b)) == [min(x, y) for x, y in zip(a_i, b_i)]
+    assert w.to_ints(w.sat_sub(a, b)) == [max(0, x - y) for x, y in zip(a_i, b_i)]
+
+
+def test_zero_max_widen(rng):
+    a_i = EDGE + rand_u128(rng, 64)
+    a = to_limb_array(a_i)
+    assert list(np.asarray(w.is_zero(a))) == [v == 0 for v in a_i]
+    assert list(np.asarray(w.is_max(a))) == [v == U128_MAX for v in a_i]
+
+    small = to_limb_array([v % (1 << 64) for v in a_i], width=2)
+    wide = w.widen(small, 4)
+    assert w.to_ints(wide) == [v % (1 << 64) for v in a_i]
+
+
+def test_mul_u32(rng):
+    xs = [0, 1, 0xFFFF, 0x10000, 0xFFFFFFFF, 1_000_000_000] + [
+        int(v) for v in rng.integers(0, 1 << 32, size=200)
+    ]
+    ys = [0xFFFFFFFF, 1_000_000_000, 0, 1, 0x10001, 123456789] + [
+        int(v) for v in rng.integers(0, 1 << 32, size=200)
+    ]
+    prod = jax.jit(w.mul_u32)(jnp.asarray(np.array(xs, np.uint32)), jnp.asarray(np.array(ys, np.uint32)))
+    assert w.to_ints(prod) == [(x * y) & ((1 << 64) - 1) for x, y in zip(xs, ys)]
+
+
+def test_from_int_roundtrip():
+    for v in EDGE:
+        assert w.to_ints(w.from_int(v)) == v

@@ -1,0 +1,16 @@
+"""tigerbeetle-tpu: a TPU-native distributed financial-accounting database.
+
+A from-scratch framework with the capabilities of TigerBeetle (reference:
+/root/reference, Zig): double-entry accounting over fixed 128-byte
+Account/Transfer records, VSR consensus, an LSM-forest storage engine, WAL +
+superblock checkpointing, and a deterministic simulation test harness.
+
+Architecture is JAX/XLA-first: the batched transfer-commit hot path runs as
+vectorized split-u128 (4x uint32 limb) arithmetic with segment-sum balance
+aggregation on TPU, behind the StateMachine operator boundary so consensus and
+the message bus stay device-agnostic.
+"""
+
+__version__ = "0.1.0"
+
+from tigerbeetle_tpu import constants, flags, results, types  # noqa: F401

@@ -1,0 +1,82 @@
+"""Cluster and process constants.
+
+Mirrors the reference's config presets and derived constants
+(/root/reference/src/config.zig:58-303, src/constants.zig). Values that define
+wire/disk compatibility (message size, batch size, record size) match the
+reference exactly; purely internal tuning values are TPU-build choices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# Wire format (reference message_header.zig:70, config.zig:78).
+MESSAGE_SIZE_MAX = 1 << 20  # 1 MiB
+HEADER_SIZE = 256
+MESSAGE_BODY_SIZE_MAX = MESSAGE_SIZE_MAX - HEADER_SIZE
+
+# 8190 = (1 MiB - 256 B) / 128 B (reference state_machine.zig:70-75).
+BATCH_MAX = MESSAGE_BODY_SIZE_MAX // 128
+assert BATCH_MAX == 8190
+
+SECTOR_SIZE = 4096
+BLOCK_SIZE = 1 << 20  # grid block size (reference config.zig:114)
+
+REPLICAS_MAX = 6
+STANDBYS_MAX = 6
+CLIENTS_MAX = 32
+PIPELINE_PREPARE_QUEUE_MAX = 8  # reference config.zig:133
+CLIENT_REQUEST_QUEUE_MAX = 32  # reference config.zig:87
+
+JOURNAL_SLOT_COUNT = 1024  # reference config.zig:136
+LSM_BATCH_MULTIPLE = 4  # reference: lsm_batch_multiple (compaction bar pacing)
+LSM_LEVELS = 7  # reference config.zig:140
+LSM_GROWTH_FACTOR = 8
+
+# Checkpoint every this many ops (reference constants.zig:47-73 derives
+# journal_slot_count - lsm_batch_multiple - pipeline margin).
+VSR_CHECKPOINT_INTERVAL = (
+    JOURNAL_SLOT_COUNT - LSM_BATCH_MULTIPLE - PIPELINE_PREPARE_QUEUE_MAX - 1
+)
+
+NS_PER_S = 1_000_000_000
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """Runtime-selected configuration preset.
+
+    `accounts_max` / `transfers_max` size the device-resident state tables
+    (the TPU build's analog of the reference's cache + LSM sizing flags,
+    reference src/tigerbeetle/cli.zig cache-* flags).
+    """
+
+    name: str = "production"
+    accounts_max: int = 1 << 20
+    transfers_max: int = 1 << 24
+    batch_max: int = BATCH_MAX
+    journal_slot_count: int = JOURNAL_SLOT_COUNT
+    pipeline_max: int = PIPELINE_PREPARE_QUEUE_MAX
+    clients_max: int = CLIENTS_MAX
+    checkpoint_interval: int = VSR_CHECKPOINT_INTERVAL
+    # Device memtable runs before a merge is forced (LSM-on-device shape).
+    state_runs_max: int = 4
+
+
+PRODUCTION = Config()
+DEVELOPMENT = Config(name="development", accounts_max=1 << 18, transfers_max=1 << 20)
+TEST_MIN = Config(
+    name="test_min",
+    accounts_max=1 << 10,
+    transfers_max=1 << 12,
+    batch_max=64,
+    journal_slot_count=32,
+    pipeline_max=4,
+    clients_max=4,
+    checkpoint_interval=16,
+    state_runs_max=2,
+)
+
+
+def config_by_name(name: str) -> Config:
+    return {"production": PRODUCTION, "development": DEVELOPMENT, "test_min": TEST_MIN}[name]

@@ -1,0 +1,181 @@
+"""Wide unsigned integer arithmetic on uint32 limb arrays, for TPU.
+
+TPUs have no native 64/128-bit integers, so u128 (and u64) values are
+represented as little-endian uint32 limb arrays: u128 → (..., 4), u64 →
+(..., 2). All functions are elementwise over leading dims, jit-compatible,
+and use only uint32 ops (no x64 requirement). Overflow semantics mirror the
+reference's `sum_overflows` (/root/reference/src/state_machine.zig:1645) and
+Zig's `-|` saturating subtraction used by the balancing clamps
+(state_machine.zig:1286-1306).
+
+The limb loops are unrolled Python loops over a static limb count (4 or 2) —
+XLA sees straight-line vector code, which fuses into the surrounding kernel.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+U32 = jnp.uint32
+LIMB_MASK = 0xFFFFFFFF
+
+
+def from_int(value: int, width: int = 4):
+    """Python int → (width,) uint32 limb constant."""
+    assert 0 <= value < (1 << (32 * width))
+    return jnp.array([(value >> (32 * i)) & LIMB_MASK for i in range(width)], dtype=U32)
+
+
+def zeros(shape, width: int = 4):
+    return jnp.zeros((*shape, width), dtype=U32)
+
+
+def broadcast_to(limbs, shape):
+    return jnp.broadcast_to(limbs, (*shape, limbs.shape[-1]))
+
+
+def widen(limbs, width: int):
+    """Zero-extend to a larger limb count (e.g. u64 (...,2) → u128 (...,4))."""
+    have = limbs.shape[-1]
+    assert have <= width
+    if have == width:
+        return limbs
+    pad = jnp.zeros((*limbs.shape[:-1], width - have), dtype=U32)
+    return jnp.concatenate([limbs, pad], axis=-1)
+
+
+def add(a, b):
+    """(a + b) mod 2^(32W), plus overflow flag. a, b: (..., W) uint32."""
+    w = a.shape[-1]
+    assert b.shape[-1] == w
+    out = []
+    carry = jnp.zeros(a.shape[:-1], dtype=U32)
+    for i in range(w):
+        s = a[..., i] + b[..., i]
+        c1 = (s < a[..., i]).astype(U32)
+        s2 = s + carry
+        c2 = (s2 < carry).astype(U32)
+        out.append(s2)
+        carry = c1 | c2  # a+b+carry_in < 2^33, so carry-out is 0 or 1
+    return jnp.stack(out, axis=-1), (carry != 0)
+
+
+def sub(a, b):
+    """(a - b) mod 2^(32W), plus underflow (borrow) flag."""
+    w = a.shape[-1]
+    assert b.shape[-1] == w
+    out = []
+    borrow = jnp.zeros(a.shape[:-1], dtype=U32)
+    for i in range(w):
+        d = a[..., i] - b[..., i]
+        b1 = (a[..., i] < b[..., i]).astype(U32)
+        d2 = d - borrow
+        b2 = (d < borrow).astype(U32)
+        out.append(d2)
+        borrow = b1 | b2
+    return jnp.stack(out, axis=-1), (borrow != 0)
+
+
+def eq(a, b):
+    acc = jnp.ones(jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1]), dtype=bool)
+    for i in range(a.shape[-1]):
+        acc = acc & (a[..., i] == b[..., i])
+    return acc
+
+
+def lt(a, b):
+    """a < b, lexicographic from the most significant limb."""
+    w = a.shape[-1]
+    shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    less = jnp.zeros(shape, dtype=bool)
+    equal = jnp.ones(shape, dtype=bool)
+    for i in reversed(range(w)):
+        less = less | (equal & (a[..., i] < b[..., i]))
+        equal = equal & (a[..., i] == b[..., i])
+    return less
+
+
+def le(a, b):
+    return ~lt(b, a)
+
+
+def gt(a, b):
+    return lt(b, a)
+
+
+def ge(a, b):
+    return ~lt(a, b)
+
+
+def is_zero(a):
+    acc = jnp.ones(a.shape[:-1], dtype=bool)
+    for i in range(a.shape[-1]):
+        acc = acc & (a[..., i] == 0)
+    return acc
+
+
+def is_max(a):
+    acc = jnp.ones(a.shape[:-1], dtype=bool)
+    for i in range(a.shape[-1]):
+        acc = acc & (a[..., i] == jnp.uint32(LIMB_MASK))
+    return acc
+
+
+def select(pred, a, b):
+    """Elementwise where over limb arrays; pred has shape a.shape[:-1]."""
+    return jnp.where(pred[..., None], a, b)
+
+
+def min_(a, b):
+    return select(lt(a, b), a, b)
+
+
+def sat_sub(a, b):
+    """Saturating a - b (Zig `-|`): 0 on underflow."""
+    d, under = sub(a, b)
+    return select(under, jnp.zeros_like(d), d)
+
+
+def sum_overflows(a, b) -> jnp.ndarray:
+    """True where a + b overflows the limb width (reference
+    state_machine.zig:1645)."""
+    _, over = add(a, b)
+    return over
+
+
+def mul_u32(a, b):
+    """Full 32x32 → 64-bit product as (..., 2) uint32 limbs.
+
+    Used for `timeout_s * NS_PER_S` (reference state_machine.zig:1326:
+    `t.timestamp + timeout * ns_per_s` in u64). Splits into 16-bit halves so
+    every partial product fits in uint32.
+    """
+    a = jnp.asarray(a, dtype=U32)
+    b = jnp.asarray(b, dtype=U32)
+    mask16 = jnp.uint32(0xFFFF)
+    al, ah = a & mask16, a >> 16
+    bl, bh = b & mask16, b >> 16
+    ll = al * bl
+    lh = al * bh
+    hl = ah * bl
+    hh = ah * bh
+    # lo = ll + (lh << 16) + (hl << 16), tracking carries into hi.
+    m1 = ll + (lh << 16)
+    c1 = (m1 < ll).astype(U32)
+    lo = m1 + (hl << 16)
+    c2 = (lo < m1).astype(U32)
+    hi = hh + (lh >> 16) + (hl >> 16) + c1 + c2
+    return jnp.stack([lo, hi], axis=-1)
+
+
+def to_ints(limbs) -> list[int] | int:
+    """Device/host limb array → Python int(s) (test helper)."""
+    import numpy as np
+
+    arr = np.asarray(limbs)
+    w = arr.shape[-1]
+    flat = arr.reshape(-1, w)
+    vals = [sum(int(row[i]) << (32 * i) for i in range(w)) for row in flat]
+    if arr.ndim == 1:
+        return vals[0]
+    return vals
