@@ -1,0 +1,512 @@
+"""Behavioral tests for the serial oracle, covering the reference's unit-test
+ground (/root/reference/src/state_machine.zig:2032-2575): account creation
+ladder, linked chains, 2-phase transfers, balancing, exists semantics."""
+
+import pytest
+
+from tigerbeetle_tpu.flags import AccountFilterFlags, AccountFlags, TransferFlags
+from tigerbeetle_tpu.models.oracle import Account, Oracle, Transfer
+from tigerbeetle_tpu.results import CreateAccountResult as AR
+from tigerbeetle_tpu.results import CreateTransferResult as TR
+from tigerbeetle_tpu.types import U64_MAX, U128_MAX
+
+L = TransferFlags.LINKED
+P = TransferFlags.PENDING
+POST = TransferFlags.POST_PENDING_TRANSFER
+VOID = TransferFlags.VOID_PENDING_TRANSFER
+BDR = TransferFlags.BALANCING_DEBIT
+BCR = TransferFlags.BALANCING_CREDIT
+
+
+def acct(id, ledger=1, code=1, **kw):
+    return Account(id=id, ledger=ledger, code=code, **kw)
+
+
+def xfer(id, dr=1, cr=2, amount=10, ledger=1, code=1, **kw):
+    return Transfer(id=id, debit_account_id=dr, credit_account_id=cr,
+                    amount=amount, ledger=ledger, code=code, **kw)
+
+
+def setup_accounts(o: Oracle, n=4, **kw):
+    evs = [acct(i + 1, **kw) for i in range(n)]
+    ts = o.prepare("create_accounts", len(evs))
+    res = o.create_accounts(evs, ts)
+    assert res == []
+    return o
+
+
+def commit_transfers(o: Oracle, evs):
+    ts = o.prepare("create_transfers", len(evs))
+    return o.create_transfers(evs, ts)
+
+
+# --- create_accounts ---------------------------------------------------------
+
+def test_create_accounts_ladder():
+    o = Oracle()
+    evs = [
+        Account(id=0),                                     # id_must_not_be_zero
+        Account(id=U128_MAX),                              # id_must_not_be_int_max
+        Account(id=1, reserved=1),                         # reserved_field
+        Account(id=1, flags=1 << 15),                      # reserved_flag
+        Account(id=1, flags=AccountFlags.DEBITS_MUST_NOT_EXCEED_CREDITS
+                | AccountFlags.CREDITS_MUST_NOT_EXCEED_DEBITS),  # mutually exclusive
+        Account(id=1, debits_pending=1),
+        Account(id=1, debits_posted=1),
+        Account(id=1, credits_pending=1),
+        Account(id=1, credits_posted=1),
+        Account(id=1, ledger=0),                           # ledger_must_not_be_zero
+        Account(id=1, ledger=1, code=0),                   # code_must_not_be_zero
+        acct(1),                                           # ok
+        acct(1),                                           # exists
+        acct(1, ledger=2),                                 # exists_with_different_ledger
+    ]
+    ts = o.prepare("create_accounts", len(evs))
+    res = o.create_accounts(evs, ts)
+    assert res == [
+        (0, AR.ID_MUST_NOT_BE_ZERO),
+        (1, AR.ID_MUST_NOT_BE_INT_MAX),
+        (2, AR.RESERVED_FIELD),
+        (3, AR.RESERVED_FLAG),
+        (4, AR.FLAGS_ARE_MUTUALLY_EXCLUSIVE),
+        (5, AR.DEBITS_PENDING_MUST_BE_ZERO),
+        (6, AR.DEBITS_POSTED_MUST_BE_ZERO),
+        (7, AR.CREDITS_PENDING_MUST_BE_ZERO),
+        (8, AR.CREDITS_POSTED_MUST_BE_ZERO),
+        (9, AR.LEDGER_MUST_NOT_BE_ZERO),
+        (10, AR.CODE_MUST_NOT_BE_ZERO),
+        (12, AR.EXISTS),
+        (13, AR.EXISTS_WITH_DIFFERENT_LEDGER),
+    ]
+    assert 1 in o.accounts
+    # Event timestamps are consecutive, ending at the batch timestamp.
+    assert o.accounts[1].timestamp == ts - len(evs) + 11 + 1
+
+
+def test_create_accounts_exists_precedence():
+    o = Oracle()
+    setup_accounts(o, 1, user_data_128=7, user_data_64=8, user_data_32=9)
+    ts = o.prepare("create_accounts", 4)
+    res = o.create_accounts(
+        [
+            acct(1, flags=AccountFlags.HISTORY),
+            acct(1, user_data_128=0),
+            Account(id=1, ledger=1, code=2, user_data_128=7, user_data_64=8, user_data_32=9),
+            Account(id=1, ledger=1, code=1, user_data_128=7, user_data_64=8, user_data_32=9),
+        ],
+        ts,
+    )
+    assert res == [
+        (0, AR.EXISTS_WITH_DIFFERENT_FLAGS),
+        (1, AR.EXISTS_WITH_DIFFERENT_USER_DATA_128),
+        (2, AR.EXISTS_WITH_DIFFERENT_CODE),
+        (3, AR.EXISTS),
+    ]
+
+
+# --- linked chains -----------------------------------------------------------
+
+def test_linked_accounts_rollback():
+    o = Oracle()
+    # chain: [ok, fail] -> both fail; first gets linked_event_failed.
+    evs = [
+        acct(10, flags=AccountFlags.LINKED),
+        Account(id=11, ledger=1, code=0),  # breaks the chain
+        acct(12),                          # independent, ok
+    ]
+    ts = o.prepare("create_accounts", len(evs))
+    res = o.create_accounts(evs, ts)
+    assert res == [
+        (0, AR.LINKED_EVENT_FAILED),
+        (1, AR.CODE_MUST_NOT_BE_ZERO),
+    ]
+    assert 10 not in o.accounts and 11 not in o.accounts and 12 in o.accounts
+
+
+def test_linked_event_chain_open():
+    o = Oracle()
+    evs = [acct(1), acct(2, flags=AccountFlags.LINKED)]
+    ts = o.prepare("create_accounts", len(evs))
+    res = o.create_accounts(evs, ts)
+    assert res == [(1, AR.LINKED_EVENT_CHAIN_OPEN)]
+    assert 1 in o.accounts and 2 not in o.accounts
+
+
+def test_linked_event_chain_open_batch_of_one():
+    o = Oracle()
+    evs = [acct(1, flags=AccountFlags.LINKED)]
+    ts = o.prepare("create_accounts", len(evs))
+    res = o.create_accounts(evs, ts)
+    assert res == [(0, AR.LINKED_EVENT_CHAIN_OPEN)]
+    assert not o.accounts
+
+
+def test_linked_chain_open_after_failed_chain():
+    # Mirrors "linked_event_chain_open for an already failed batch".
+    o = Oracle()
+    evs = [
+        acct(1, flags=AccountFlags.LINKED),
+        Account(id=2, ledger=0, code=1, flags=AccountFlags.LINKED),
+        acct(3, flags=AccountFlags.LINKED),
+    ]
+    ts = o.prepare("create_accounts", len(evs))
+    res = o.create_accounts(evs, ts)
+    assert res == [
+        (0, AR.LINKED_EVENT_FAILED),
+        (1, AR.LEDGER_MUST_NOT_BE_ZERO),
+        (2, AR.LINKED_EVENT_CHAIN_OPEN),
+    ]
+    assert not o.accounts
+
+
+def test_two_chains_independent():
+    o = Oracle()
+    evs = [
+        acct(1, flags=AccountFlags.LINKED), acct(2),           # chain 1 ok
+        acct(3, flags=AccountFlags.LINKED), Account(id=4, ledger=1, code=0),  # chain 2 fails
+    ]
+    ts = o.prepare("create_accounts", len(evs))
+    res = o.create_accounts(evs, ts)
+    assert res == [(2, AR.LINKED_EVENT_FAILED), (3, AR.CODE_MUST_NOT_BE_ZERO)]
+    assert set(o.accounts) == {1, 2}
+
+
+# --- create_transfers --------------------------------------------------------
+
+def test_create_transfer_ladder():
+    o = Oracle()
+    setup_accounts(o, 2)
+    res = commit_transfers(o, [
+        Transfer(id=0),
+        Transfer(id=U128_MAX),
+        Transfer(id=1, flags=1 << 14),
+        xfer(1, dr=0),
+        xfer(1, dr=U128_MAX),
+        xfer(1, cr=0),
+        xfer(1, cr=U128_MAX),
+        xfer(1, dr=1, cr=1),
+        xfer(1, pending_id=5),
+        xfer(1, timeout=5),           # timeout_reserved_for_pending_transfer
+        xfer(1, amount=0),            # amount_must_not_be_zero
+        xfer(1, ledger=0),
+        xfer(1, code=0),
+        xfer(1, dr=9),                # debit_account_not_found
+        xfer(1, cr=9),                # credit_account_not_found
+        xfer(1, ledger=2),            # transfer_must_have_the_same_ledger_as_accounts
+        xfer(1, amount=100),          # ok
+        xfer(1, amount=100),          # exists
+        xfer(1, amount=101),          # exists_with_different_amount
+    ])
+    assert res == [
+        (0, TR.ID_MUST_NOT_BE_ZERO),
+        (1, TR.ID_MUST_NOT_BE_INT_MAX),
+        (2, TR.RESERVED_FLAG),
+        (3, TR.DEBIT_ACCOUNT_ID_MUST_NOT_BE_ZERO),
+        (4, TR.DEBIT_ACCOUNT_ID_MUST_NOT_BE_INT_MAX),
+        (5, TR.CREDIT_ACCOUNT_ID_MUST_NOT_BE_ZERO),
+        (6, TR.CREDIT_ACCOUNT_ID_MUST_NOT_BE_INT_MAX),
+        (7, TR.ACCOUNTS_MUST_BE_DIFFERENT),
+        (8, TR.PENDING_ID_MUST_BE_ZERO),
+        (9, TR.TIMEOUT_RESERVED_FOR_PENDING_TRANSFER),
+        (10, TR.AMOUNT_MUST_NOT_BE_ZERO),
+        (11, TR.LEDGER_MUST_NOT_BE_ZERO),
+        (12, TR.CODE_MUST_NOT_BE_ZERO),
+        (13, TR.DEBIT_ACCOUNT_NOT_FOUND),
+        (14, TR.CREDIT_ACCOUNT_NOT_FOUND),
+        (15, TR.TRANSFER_MUST_HAVE_THE_SAME_LEDGER_AS_ACCOUNTS),
+        (17, TR.EXISTS),
+        (18, TR.EXISTS_WITH_DIFFERENT_AMOUNT),
+    ]
+    assert o.accounts[1].debits_posted == 100
+    assert o.accounts[2].credits_posted == 100
+
+
+def test_accounts_must_have_same_ledger():
+    o = Oracle()
+    ts = o.prepare("create_accounts", 2)
+    o.create_accounts([acct(1, ledger=1), acct(2, ledger=2)], ts)
+    res = commit_transfers(o, [xfer(1)])
+    assert res == [(0, TR.ACCOUNTS_MUST_HAVE_THE_SAME_LEDGER)]
+
+
+def test_two_phase_post_and_void():
+    o = Oracle()
+    setup_accounts(o, 2)
+    assert commit_transfers(o, [xfer(1, amount=100, flags=P, timeout=0)]) == []
+    assert o.accounts[1].debits_pending == 100
+    assert o.accounts[2].credits_pending == 100
+
+    # Post with a smaller amount.
+    assert commit_transfers(o, [Transfer(id=2, pending_id=1, amount=60, flags=POST)]) == []
+    a1, a2 = o.accounts[1], o.accounts[2]
+    assert a1.debits_pending == 0 and a1.debits_posted == 60
+    assert a2.credits_pending == 0 and a2.credits_posted == 60
+    # The committed post transfer inherits the pending transfer's accounts.
+    t2 = o.transfers[2]
+    assert t2.debit_account_id == 1 and t2.credit_account_id == 2 and t2.amount == 60
+
+    # Already posted.
+    assert commit_transfers(o, [Transfer(id=3, pending_id=1, flags=POST)]) == [
+        (0, TR.PENDING_TRANSFER_ALREADY_POSTED)
+    ]
+    # Void another pending.
+    assert commit_transfers(o, [xfer(4, amount=10, flags=P)]) == []
+    assert commit_transfers(o, [Transfer(id=5, pending_id=4, flags=VOID)]) == []
+    assert o.accounts[1].debits_pending == 0
+    assert commit_transfers(o, [Transfer(id=6, pending_id=4, flags=VOID)]) == [
+        (0, TR.PENDING_TRANSFER_ALREADY_VOIDED)
+    ]
+
+
+def test_post_pending_validation():
+    o = Oracle()
+    setup_accounts(o, 2)
+    assert commit_transfers(o, [xfer(1, amount=100, flags=P)]) == []
+    assert commit_transfers(o, [xfer(7, amount=5)]) == []  # non-pending
+    res = commit_transfers(o, [
+        Transfer(id=2, pending_id=0, flags=POST),
+        Transfer(id=2, pending_id=U128_MAX, flags=POST),
+        Transfer(id=2, pending_id=2, flags=POST),
+        Transfer(id=2, pending_id=1, flags=POST | VOID),
+        Transfer(id=2, pending_id=1, flags=POST | P),
+        Transfer(id=2, pending_id=1, flags=POST, timeout=3),
+        Transfer(id=2, pending_id=99, flags=POST),
+        Transfer(id=2, pending_id=7, flags=POST),        # not pending
+        Transfer(id=2, pending_id=1, debit_account_id=9, flags=POST),
+        Transfer(id=2, pending_id=1, credit_account_id=9, flags=POST),
+        Transfer(id=2, pending_id=1, ledger=9, flags=POST),
+        Transfer(id=2, pending_id=1, code=9, flags=POST),
+        Transfer(id=2, pending_id=1, amount=101, flags=POST),  # exceeds pending amount
+        Transfer(id=2, pending_id=1, amount=50, flags=VOID),   # void with different amount
+    ])
+    assert res == [
+        (0, TR.PENDING_ID_MUST_NOT_BE_ZERO),
+        (1, TR.PENDING_ID_MUST_NOT_BE_INT_MAX),
+        (2, TR.PENDING_ID_MUST_BE_DIFFERENT),
+        (3, TR.FLAGS_ARE_MUTUALLY_EXCLUSIVE),
+        (4, TR.FLAGS_ARE_MUTUALLY_EXCLUSIVE),
+        (5, TR.TIMEOUT_RESERVED_FOR_PENDING_TRANSFER),
+        (6, TR.PENDING_TRANSFER_NOT_FOUND),
+        (7, TR.PENDING_TRANSFER_NOT_PENDING),
+        (8, TR.PENDING_TRANSFER_HAS_DIFFERENT_DEBIT_ACCOUNT_ID),
+        (9, TR.PENDING_TRANSFER_HAS_DIFFERENT_CREDIT_ACCOUNT_ID),
+        (10, TR.PENDING_TRANSFER_HAS_DIFFERENT_LEDGER),
+        (11, TR.PENDING_TRANSFER_HAS_DIFFERENT_CODE),
+        (12, TR.EXCEEDS_PENDING_TRANSFER_AMOUNT),
+        (13, TR.PENDING_TRANSFER_HAS_DIFFERENT_AMOUNT),
+    ]
+
+
+def test_pending_expiry():
+    o = Oracle()
+    setup_accounts(o, 2)
+    assert commit_transfers(o, [xfer(1, amount=100, flags=P, timeout=1)]) == []
+    p_ts = o.transfers[1].timestamp
+    # Advance prepare_timestamp past the timeout (1s = 1e9 ns).
+    o.prepare_timestamp = p_ts + 10**9 + 5
+    res = commit_transfers(o, [Transfer(id=2, pending_id=1, flags=POST)])
+    assert res == [(0, TR.PENDING_TRANSFER_EXPIRED)]
+    # Balances unchanged (expiry itself is lazy in this snapshot).
+    assert o.accounts[1].debits_pending == 100
+
+
+def test_failed_transfer_does_not_exist():
+    o = Oracle()
+    setup_accounts(o, 2)
+    commit_transfers(o, [Transfer(id=1, debit_account_id=1, credit_account_id=2,
+                                  amount=10, ledger=0, code=1)])
+    assert 1 not in o.transfers
+    assert commit_transfers(o, [xfer(1)]) == []
+
+
+def test_failed_linked_chain_undone_within_commit():
+    o = Oracle()
+    setup_accounts(o, 2)
+    res = commit_transfers(o, [
+        xfer(1, amount=10, flags=L),
+        Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=10,
+                 ledger=9, code=1),
+        xfer(3, amount=7),
+    ])
+    assert res == [
+        (0, TR.LINKED_EVENT_FAILED),
+        (1, TR.TRANSFER_MUST_HAVE_THE_SAME_LEDGER_AS_ACCOUNTS),
+    ]
+    assert 1 not in o.transfers and 3 in o.transfers
+    assert o.accounts[1].debits_posted == 7
+
+
+def test_linked_chain_same_id_retry_inside_chain():
+    # After a rolled-back chain, the same ids can be reused in a later chain.
+    o = Oracle()
+    setup_accounts(o, 2)
+    res = commit_transfers(o, [
+        xfer(1, amount=10, flags=L),
+        Transfer(id=2, flags=1 << 14),  # reserved flag breaks the chain
+    ])
+    assert res == [(0, TR.LINKED_EVENT_FAILED), (1, TR.RESERVED_FLAG)]
+    assert commit_transfers(o, [xfer(1, amount=10)]) == []
+
+
+# --- balancing ---------------------------------------------------------------
+
+def test_balancing_debit_clamp():
+    o = Oracle()
+    setup_accounts(o, 3)
+    # Give account 1 credits_posted = 100.
+    assert commit_transfers(o, [xfer(1, dr=3, cr=1, amount=100)]) == []
+    # balancing_debit: amount clamped to available credits (100).
+    assert commit_transfers(o, [xfer(2, dr=1, cr=2, amount=250, flags=BDR)]) == []
+    assert o.transfers[2].amount == 100
+    assert o.accounts[1].debits_posted == 100
+    # Nothing left: exceeds_credits.
+    assert commit_transfers(o, [xfer(3, dr=1, cr=2, amount=1, flags=BDR)]) == [
+        (0, TR.EXCEEDS_CREDITS)
+    ]
+
+
+def test_balancing_credit_clamp():
+    o = Oracle()
+    setup_accounts(o, 3)
+    # Give account 2 debits_posted = 40.
+    assert commit_transfers(o, [xfer(1, dr=2, cr=3, amount=40)]) == []
+    # balancing_credit on cr=2: clamp to debits_posted - credits = 40.
+    assert commit_transfers(o, [xfer(2, dr=1, cr=2, amount=99, flags=BCR)]) == []
+    assert o.transfers[2].amount == 40
+    assert commit_transfers(o, [xfer(3, dr=1, cr=2, amount=1, flags=BCR)]) == [
+        (0, TR.EXCEEDS_DEBITS)
+    ]
+
+
+def test_balancing_amount_zero_means_maximum():
+    o = Oracle()
+    setup_accounts(o, 3)
+    assert commit_transfers(o, [xfer(1, dr=3, cr=1, amount=77)]) == []
+    # amount=0 with balancing_debit → take everything available.
+    assert commit_transfers(o, [xfer(2, dr=1, cr=2, amount=0, flags=BDR)]) == []
+    assert o.transfers[2].amount == 77
+
+
+def test_balancing_both_flags():
+    o = Oracle()
+    setup_accounts(o, 4)
+    assert commit_transfers(o, [xfer(1, dr=3, cr=1, amount=50)]) == []   # acc1 has 50 credits
+    assert commit_transfers(o, [xfer(2, dr=2, cr=4, amount=30)]) == []   # acc2 has 30 debits
+    # both balancing flags: min of both sides = 30.
+    assert commit_transfers(o, [xfer(3, dr=1, cr=2, amount=99, flags=BDR | BCR)]) == []
+    assert o.transfers[3].amount == 30
+
+
+def test_balancing_pending():
+    o = Oracle()
+    setup_accounts(o, 3)
+    assert commit_transfers(o, [xfer(1, dr=3, cr=1, amount=20)]) == []
+    assert commit_transfers(o, [xfer(2, dr=1, cr=2, amount=0, flags=BDR | P)]) == []
+    assert o.transfers[2].amount == 20
+    assert o.accounts[1].debits_pending == 20
+    # Pending debits now count against the balance.
+    assert commit_transfers(o, [xfer(3, dr=1, cr=2, amount=0, flags=BDR)]) == [
+        (0, TR.EXCEEDS_CREDITS)
+    ]
+
+
+def test_must_not_exceed_limits():
+    o = Oracle()
+    ts = o.prepare("create_accounts", 3)
+    o.create_accounts([
+        acct(1, flags=AccountFlags.DEBITS_MUST_NOT_EXCEED_CREDITS),
+        acct(2, flags=AccountFlags.CREDITS_MUST_NOT_EXCEED_DEBITS),
+        acct(3),
+    ], ts)
+    # Account 1 has no credits: any debit exceeds.
+    assert commit_transfers(o, [xfer(1, dr=1, cr=3, amount=1)]) == [(0, TR.EXCEEDS_CREDITS)]
+    # Account 2 has no debits: any credit exceeds.
+    assert commit_transfers(o, [xfer(2, dr=3, cr=2, amount=1)]) == [(0, TR.EXCEEDS_DEBITS)]
+    # Fund account 1 then spend within limit.
+    assert commit_transfers(o, [xfer(3, dr=3, cr=1, amount=10)]) == []
+    assert commit_transfers(o, [xfer(4, dr=1, cr=3, amount=10)]) == []
+    assert commit_transfers(o, [xfer(5, dr=1, cr=3, amount=1)]) == [(0, TR.EXCEEDS_CREDITS)]
+
+
+# --- overflow ----------------------------------------------------------------
+
+def test_overflow_checks():
+    o = Oracle()
+    setup_accounts(o, 3)
+    big = U128_MAX - 5
+    assert commit_transfers(o, [xfer(1, amount=big)]) == []
+    res = commit_transfers(o, [xfer(2, amount=100)])
+    assert res == [(0, TR.OVERFLOWS_DEBITS_POSTED)]
+    # Pending-side overflow: pile debits_pending up on a fresh debit account.
+    assert commit_transfers(o, [xfer(3, dr=2, cr=3, amount=big, flags=P)]) == []
+    res = commit_transfers(o, [xfer(4, dr=2, cr=3, amount=100, flags=P)])
+    assert res == [(0, TR.OVERFLOWS_DEBITS_PENDING)]
+    # Combined pending+posted overflow (overflows_debits) on the debit side.
+    o2 = Oracle()
+    setup_accounts(o2, 3)
+    assert commit_transfers(o2, [xfer(1, amount=big, flags=P)]) == []
+    assert commit_transfers(o2, [xfer(2, amount=3)]) == []
+    res = commit_transfers(o2, [xfer(3, amount=4)])
+    assert res == [(0, TR.OVERFLOWS_DEBITS)]
+
+
+def test_overflows_timeout():
+    o = Oracle()
+    setup_accounts(o, 2)
+    o.prepare_timestamp = U64_MAX - 1000
+    res = commit_transfers(o, [xfer(1, amount=1, flags=P, timeout=4_000_000_000)])
+    assert res == [(0, TR.OVERFLOWS_TIMEOUT)]
+
+
+# --- queries -----------------------------------------------------------------
+
+def test_lookup():
+    o = Oracle()
+    setup_accounts(o, 2)
+    commit_transfers(o, [xfer(1, amount=5)])
+    assert [a.id for a in o.lookup_accounts([1, 9, 2])] == [1, 2]
+    assert [t.id for t in o.lookup_transfers([9, 1])] == [1]
+
+
+def test_get_account_transfers():
+    o = Oracle()
+    setup_accounts(o, 3)
+    commit_transfers(o, [xfer(1, dr=1, cr=2, amount=5),
+                         xfer(2, dr=2, cr=1, amount=6),
+                         xfer(3, dr=2, cr=3, amount=7)])
+    both = o.get_account_transfers(1)
+    assert [t.id for t in both] == [1, 2]
+    only_dr = o.get_account_transfers(1, flags=AccountFilterFlags.DEBITS)
+    assert [t.id for t in only_dr] == [1]
+    rev = o.get_account_transfers(
+        1, flags=AccountFilterFlags.DEBITS | AccountFilterFlags.CREDITS | AccountFilterFlags.REVERSED)
+    assert [t.id for t in rev] == [2, 1]
+    assert o.get_account_transfers(1, limit=1)[0].id == 1
+    assert o.get_account_transfers(0) == []
+    assert o.get_account_transfers(1, limit=0) == []
+    assert o.get_account_transfers(1, timestamp_min=5, timestamp_max=4) == []
+
+
+def test_get_account_history():
+    o = Oracle()
+    ts = o.prepare("create_accounts", 2)
+    o.create_accounts([acct(1, flags=AccountFlags.HISTORY), acct(2)], ts)
+    commit_transfers(o, [xfer(1, dr=1, cr=2, amount=5)])
+    commit_transfers(o, [xfer(2, dr=2, cr=1, amount=3)])
+    rows = o.get_account_history(1)
+    assert len(rows) == 2
+    # After transfer 1: debits_posted=5; after transfer 2: credits_posted=3.
+    assert rows[0][2] == 5 and rows[1][4] == 3
+    # Account 2 has no history flag.
+    assert o.get_account_history(2) == []
+
+
+def test_timestamps_are_consecutive():
+    o = Oracle()
+    setup_accounts(o, 2)
+    ts = o.prepare("create_transfers", 3)
+    o.create_transfers([xfer(1, amount=1), xfer(2, amount=1), xfer(3, amount=1)], ts)
+    assert o.transfers[1].timestamp == ts - 2
+    assert o.transfers[2].timestamp == ts - 1
+    assert o.transfers[3].timestamp == ts
+    assert o.commit_timestamp == ts

@@ -23,7 +23,15 @@ def rand_u128(rng, n):
     bits = rng.integers(0, 129, size=n)
     vals = []
     for b in bits:
-        vals.append(int(rng.integers(0, 1 << 30)) if b == 0 else rng.integers(0, 1 << 62).item() % (1 << int(b)))
+        b = int(b)
+        if b == 0:
+            vals.append(0)
+            continue
+        # Compose a full-width random value from 32-bit draws, then mask to b bits.
+        v = 0
+        for word in range(4):
+            v |= int(rng.integers(0, 1 << 32)) << (32 * word)
+        vals.append(v % (1 << b))
     return vals
 
 

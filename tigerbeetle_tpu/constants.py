@@ -33,11 +33,16 @@ LSM_BATCH_MULTIPLE = 4  # reference: lsm_batch_multiple (compaction bar pacing)
 LSM_LEVELS = 7  # reference config.zig:140
 LSM_GROWTH_FACTOR = 8
 
-# Checkpoint every this many ops (reference constants.zig:47-73 derives
-# journal_slot_count - lsm_batch_multiple - pipeline margin).
+# Checkpoint every this many ops (reference constants.zig:47-73):
+# journal_slot_count - lsm_batch_multiple
+#   - lsm_batch_multiple * ceil(pipeline_prepare_queue_max / lsm_batch_multiple),
+# and the result must stay a multiple of lsm_batch_multiple (compaction bars).
 VSR_CHECKPOINT_INTERVAL = (
-    JOURNAL_SLOT_COUNT - LSM_BATCH_MULTIPLE - PIPELINE_PREPARE_QUEUE_MAX - 1
+    JOURNAL_SLOT_COUNT
+    - LSM_BATCH_MULTIPLE
+    - LSM_BATCH_MULTIPLE * (-(-PIPELINE_PREPARE_QUEUE_MAX // LSM_BATCH_MULTIPLE))
 )
+assert VSR_CHECKPOINT_INTERVAL % LSM_BATCH_MULTIPLE == 0
 
 NS_PER_S = 1_000_000_000
 

@@ -1,0 +1,1 @@
+from tigerbeetle_tpu.models import oracle  # noqa: F401
