@@ -1,0 +1,423 @@
+"""StateMachine (device kernels + host orchestration) vs Oracle byte-equality.
+
+The acceptance bar from SURVEY.md §7: byte-identical balances and result
+arrays between the TPU-path state machine and the serial oracle, across all
+semantic features (linked chains, pending/post/void, balancing, limits,
+duplicates). Random workloads are generated so that both the parallel fast
+path and the serial fallback are exercised (see `sm.stats` assertions).
+"""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.constants import TEST_MIN, Config
+from tigerbeetle_tpu.flags import AccountFlags, TransferFlags
+from tigerbeetle_tpu.models.oracle import (
+    Oracle,
+    account_from_numpy,
+    transfer_from_numpy,
+)
+from tigerbeetle_tpu.models.state_machine import StateMachine
+from tigerbeetle_tpu.results import CreateTransferResult as TR
+
+CFG = Config(name="unit", accounts_max=1 << 12, transfers_max=1 << 14, batch_max=64)
+
+
+def run_both(account_batches, transfer_batches):
+    """Run the same batches through StateMachine and Oracle; compare exactly."""
+    sm = StateMachine(CFG)
+    orc = Oracle()
+    for batch in account_batches:
+        ts = orc.prepare("create_accounts", len(batch))
+        expected = orc.create_accounts([account_from_numpy(r) for r in batch], ts)
+        got = sm.create_accounts(batch)
+        assert [(int(i), int(r)) for i, r in zip(got["index"], got["result"])] == [
+            (i, r) for i, r in expected
+        ], f"create_accounts results diverge"
+    for batch in transfer_batches:
+        ts = orc.prepare("create_transfers", len(batch))
+        expected = orc.create_transfers([transfer_from_numpy(r) for r in batch], ts)
+        got = sm.create_transfers(batch)
+        assert [(int(i), int(r)) for i, r in zip(got["index"], got["result"])] == [
+            (i, r) for i, r in expected
+        ], f"create_transfers results diverge"
+    check_equal(sm, orc)
+    return sm, orc
+
+
+def check_equal(sm: StateMachine, orc: Oracle):
+    """Byte-compare every account and transfer between the two."""
+    ids = sorted(orc.accounts.keys())
+    lo = np.array([i & types.U64_MAX for i in ids], dtype=np.uint64)
+    hi = np.array([i >> 64 for i in ids], dtype=np.uint64)
+    recs = sm.lookup_accounts(lo, hi)
+    assert len(recs) == len(ids)
+    for rec, ident in zip(recs, ids):
+        a = orc.accounts[ident]
+        assert types.u128_of(rec, "id") == a.id
+        for f in ("debits_pending", "debits_posted", "credits_pending", "credits_posted"):
+            assert types.u128_of(rec, f) == getattr(a, f), (
+                f"account {ident} field {f}: {types.u128_of(rec, f)} != {getattr(a, f)}"
+            )
+        assert int(rec["ledger"]) == a.ledger
+        assert int(rec["flags"]) == a.flags
+        assert int(rec["timestamp"]) == a.timestamp
+
+    tids = sorted(orc.transfers.keys())
+    tlo = np.array([i & types.U64_MAX for i in tids], dtype=np.uint64)
+    thi = np.array([i >> 64 for i in tids], dtype=np.uint64)
+    trecs = sm.lookup_transfers(tlo, thi)
+    assert len(trecs) == len(tids)
+    for rec, ident in zip(trecs, tids):
+        t = orc.transfers[ident]
+        got = transfer_from_numpy(rec)
+        assert got == t, f"transfer {ident}: {got} != {t}"
+
+    assert sm.commit_timestamp == orc.commit_timestamp
+
+
+def simple_accounts(n, ledger=1, flags=0, start_id=1):
+    return types.batch(
+        [types.account(id=start_id + i, ledger=ledger, code=10, flags=flags) for i in range(n)],
+        types.ACCOUNT_DTYPE,
+    )
+
+
+class TestFastPath:
+    def test_simple_transfers(self):
+        accounts = simple_accounts(4)
+        transfers = types.batch(
+            [
+                types.transfer(id=100 + i, debit_account_id=1 + (i % 3), credit_account_id=4,
+                               amount=10 + i, ledger=1, code=7)
+                for i in range(16)
+            ],
+            types.TRANSFER_DTYPE,
+        )
+        sm, orc = run_both([accounts], [transfers])
+        assert sm.stats["fast_batches"] == 1
+        assert sm.stats["serial_batches"] == 0
+
+    def test_pending_transfers_fast(self):
+        accounts = simple_accounts(2)
+        transfers = types.batch(
+            [
+                types.transfer(id=100 + i, debit_account_id=1, credit_account_id=2,
+                               amount=5, timeout=100, ledger=1, code=7,
+                               flags=TransferFlags.PENDING)
+                for i in range(8)
+            ],
+            types.TRANSFER_DTYPE,
+        )
+        sm, orc = run_both([accounts], [transfers])
+        assert sm.stats["fast_batches"] == 1
+
+    def test_validation_errors_fast(self):
+        accounts = simple_accounts(3)
+        bad = [
+            types.transfer(id=0, debit_account_id=1, credit_account_id=2, amount=1, ledger=1, code=1),
+            types.transfer(id=types.U128_MAX, debit_account_id=1, credit_account_id=2, amount=1, ledger=1, code=1),
+            types.transfer(id=201, debit_account_id=0, credit_account_id=2, amount=1, ledger=1, code=1),
+            types.transfer(id=202, debit_account_id=1, credit_account_id=1, amount=1, ledger=1, code=1),
+            types.transfer(id=203, debit_account_id=1, credit_account_id=2, amount=0, ledger=1, code=1),
+            types.transfer(id=204, debit_account_id=1, credit_account_id=2, amount=1, ledger=0, code=1),
+            types.transfer(id=205, debit_account_id=1, credit_account_id=2, amount=1, ledger=1, code=0),
+            types.transfer(id=206, debit_account_id=1, credit_account_id=2, amount=1, ledger=1, code=1, timeout=5),
+            types.transfer(id=207, debit_account_id=99, credit_account_id=2, amount=1, ledger=1, code=1),
+            types.transfer(id=208, debit_account_id=1, credit_account_id=99, amount=1, ledger=1, code=1),
+            types.transfer(id=209, debit_account_id=1, credit_account_id=2, amount=1, ledger=2, code=1),
+            types.transfer(id=210, debit_account_id=1, credit_account_id=2, amount=1, ledger=1, code=1, pending_id=5),
+            types.transfer(id=211, debit_account_id=1, credit_account_id=2, amount=1, ledger=1, code=1, timestamp=77),
+            types.transfer(id=212, debit_account_id=1, credit_account_id=2, amount=1, ledger=1, code=1),
+        ]
+        sm, orc = run_both([accounts], [types.batch(bad, types.TRANSFER_DTYPE)])
+        assert sm.stats["fast_batches"] == 1
+
+    def test_ledger_mismatch_between_accounts(self):
+        a1 = simple_accounts(2, ledger=1, start_id=1)
+        a2 = simple_accounts(2, ledger=2, start_id=10)
+        transfers = types.batch(
+            [types.transfer(id=100, debit_account_id=1, credit_account_id=10, amount=1,
+                            ledger=1, code=1)],
+            types.TRANSFER_DTYPE,
+        )
+        run_both([a1, a2], [transfers])
+
+
+class TestSerialPath:
+    def test_linked_chain_rollback(self):
+        accounts = simple_accounts(4)
+        L = TransferFlags.LINKED
+        transfers = types.batch(
+            [
+                types.transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10, ledger=1, code=1, flags=L),
+                types.transfer(id=2, debit_account_id=1, credit_account_id=2, amount=0, ledger=1, code=1),  # fails → chain rolls back
+                types.transfer(id=3, debit_account_id=3, credit_account_id=4, amount=5, ledger=1, code=1),
+            ],
+            types.TRANSFER_DTYPE,
+        )
+        sm, orc = run_both([accounts], [transfers])
+        assert sm.stats["serial_batches"] == 1
+
+    def test_pending_post_void(self):
+        accounts = simple_accounts(2)
+        P = TransferFlags.PENDING
+        transfers1 = types.batch(
+            [
+                types.transfer(id=1, debit_account_id=1, credit_account_id=2, amount=100, ledger=1, code=1, flags=P),
+                types.transfer(id=2, debit_account_id=1, credit_account_id=2, amount=50, ledger=1, code=1, flags=P),
+            ],
+            types.TRANSFER_DTYPE,
+        )
+        transfers2 = types.batch(
+            [
+                types.transfer(id=10, pending_id=1, ledger=1, code=1,
+                               flags=TransferFlags.POST_PENDING_TRANSFER),
+                types.transfer(id=11, pending_id=2, ledger=1, code=1,
+                               flags=TransferFlags.VOID_PENDING_TRANSFER),
+                types.transfer(id=12, pending_id=1, ledger=1, code=1,
+                               flags=TransferFlags.POST_PENDING_TRANSFER),  # already posted
+            ],
+            types.TRANSFER_DTYPE,
+        )
+        run_both([accounts], [transfers1, transfers2])
+
+    def test_post_pending_same_batch(self):
+        accounts = simple_accounts(2)
+        transfers = types.batch(
+            [
+                types.transfer(id=1, debit_account_id=1, credit_account_id=2, amount=100,
+                               ledger=1, code=1, flags=TransferFlags.PENDING),
+                types.transfer(id=2, pending_id=1, amount=40, ledger=1, code=1,
+                               flags=TransferFlags.POST_PENDING_TRANSFER),
+            ],
+            types.TRANSFER_DTYPE,
+        )
+        run_both([accounts], [transfers])
+
+    def test_balancing_transfers(self):
+        accounts = types.batch(
+            [
+                types.account(id=1, ledger=1, code=1),
+                types.account(id=2, ledger=1, code=1),
+            ],
+            types.ACCOUNT_DTYPE,
+        )
+        seed = types.batch(
+            [types.transfer(id=1, debit_account_id=2, credit_account_id=1, amount=70, ledger=1, code=1)],
+            types.TRANSFER_DTYPE,
+        )
+        balancing = types.batch(
+            [
+                types.transfer(id=2, debit_account_id=1, credit_account_id=2, amount=100,
+                               ledger=1, code=1, flags=TransferFlags.BALANCING_DEBIT),
+                types.transfer(id=3, debit_account_id=1, credit_account_id=2, amount=100,
+                               ledger=1, code=1, flags=TransferFlags.BALANCING_DEBIT),
+            ],
+            types.TRANSFER_DTYPE,
+        )
+        run_both([accounts], [seed, balancing])
+
+    def test_limit_flags_route_serial(self):
+        accounts = types.batch(
+            [
+                types.account(id=1, ledger=1, code=1,
+                              flags=AccountFlags.DEBITS_MUST_NOT_EXCEED_CREDITS),
+                types.account(id=2, ledger=1, code=1),
+            ],
+            types.ACCOUNT_DTYPE,
+        )
+        transfers = types.batch(
+            [
+                types.transfer(id=1, debit_account_id=2, credit_account_id=1, amount=30, ledger=1, code=1),
+                types.transfer(id=2, debit_account_id=1, credit_account_id=2, amount=20, ledger=1, code=1),
+                types.transfer(id=3, debit_account_id=1, credit_account_id=2, amount=20, ledger=1, code=1),  # exceeds
+            ],
+            types.TRANSFER_DTYPE,
+        )
+        sm, orc = run_both([accounts], [transfers])
+        assert sm.stats["serial_batches"] >= 1
+
+    def test_duplicate_ids_in_batch(self):
+        accounts = simple_accounts(2)
+        transfers = types.batch(
+            [
+                types.transfer(id=7, debit_account_id=1, credit_account_id=2, amount=3, ledger=1, code=1),
+                types.transfer(id=7, debit_account_id=1, credit_account_id=2, amount=3, ledger=1, code=1),
+                types.transfer(id=7, debit_account_id=1, credit_account_id=2, amount=4, ledger=1, code=1),
+            ],
+            types.TRANSFER_DTYPE,
+        )
+        run_both([accounts], [transfers])
+
+    def test_exists_across_batches(self):
+        accounts = simple_accounts(2)
+        t1 = types.batch(
+            [types.transfer(id=7, debit_account_id=1, credit_account_id=2, amount=3, ledger=1, code=1)],
+            types.TRANSFER_DTYPE,
+        )
+        t2 = types.batch(
+            [
+                types.transfer(id=7, debit_account_id=1, credit_account_id=2, amount=3, ledger=1, code=1),
+                types.transfer(id=7, debit_account_id=1, credit_account_id=2, amount=9, ledger=1, code=1),
+            ],
+            types.TRANSFER_DTYPE,
+        )
+        run_both([accounts], [t1, t2])
+
+    def test_history_accounts(self):
+        accounts = types.batch(
+            [
+                types.account(id=1, ledger=1, code=1, flags=AccountFlags.HISTORY),
+                types.account(id=2, ledger=1, code=1),
+            ],
+            types.ACCOUNT_DTYPE,
+        )
+        transfers = types.batch(
+            [
+                types.transfer(id=1, debit_account_id=1, credit_account_id=2, amount=5, ledger=1, code=1),
+                types.transfer(id=2, debit_account_id=2, credit_account_id=1, amount=3, ledger=1, code=1),
+            ],
+            types.TRANSFER_DTYPE,
+        )
+        sm, orc = run_both([accounts], [transfers])
+        got = sm.get_account_history(1)
+        want = orc.get_account_history(1)
+        assert got == want and len(got) == 2
+
+
+class TestRandomized:
+    """Property tests: random mixed workloads, fast+serial interleaved."""
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_workload(self, seed):
+        rng = np.random.default_rng(seed)
+        n_accounts = 12
+        account_batches = []
+        recs = []
+        for i in range(n_accounts):
+            flags = 0
+            r = rng.random()
+            if r < 0.15:
+                flags = int(AccountFlags.DEBITS_MUST_NOT_EXCEED_CREDITS)
+            elif r < 0.25:
+                flags = int(AccountFlags.CREDITS_MUST_NOT_EXCEED_DEBITS)
+            elif r < 0.3:
+                flags = int(AccountFlags.HISTORY)
+            recs.append(
+                types.account(id=i + 1, ledger=int(rng.integers(1, 3)), code=1, flags=flags)
+            )
+        account_batches.append(types.batch(recs, types.ACCOUNT_DTYPE))
+
+        transfer_batches = []
+        next_id = 1000
+        pending_ids = []
+        for _ in range(6):
+            batch = []
+            bn = int(rng.integers(1, 24))
+            for _ in range(bn):
+                kind = rng.random()
+                flags = 0
+                pending_id = 0
+                amount = int(rng.integers(0, 50))
+                timeout = 0
+                if kind < 0.12 and pending_ids:
+                    flags = int(
+                        TransferFlags.POST_PENDING_TRANSFER
+                        if rng.random() < 0.5
+                        else TransferFlags.VOID_PENDING_TRANSFER
+                    )
+                    pending_id = int(rng.choice(pending_ids))
+                    amount = int(rng.integers(0, 30))
+                elif kind < 0.3:
+                    flags = int(TransferFlags.PENDING)
+                    timeout = int(rng.integers(0, 5))
+                    pending_ids.append(next_id)
+                elif kind < 0.4:
+                    flags = int(
+                        TransferFlags.BALANCING_DEBIT
+                        if rng.random() < 0.5
+                        else TransferFlags.BALANCING_CREDIT
+                    )
+                if rng.random() < 0.2:
+                    flags |= int(TransferFlags.LINKED)
+                # occasionally duplicate an id
+                tid = next_id
+                if rng.random() < 0.08 and next_id > 1000:
+                    tid = int(rng.integers(1000, next_id))
+                else:
+                    next_id += 1
+                batch.append(
+                    types.transfer(
+                        id=tid,
+                        debit_account_id=int(rng.integers(0, n_accounts + 2)),
+                        credit_account_id=int(rng.integers(1, n_accounts + 2)),
+                        amount=amount,
+                        pending_id=pending_id,
+                        timeout=timeout,
+                        ledger=int(rng.integers(1, 3)),
+                        code=int(rng.integers(0, 3)),
+                        flags=flags,
+                    )
+                )
+            # last event must not leave a chain open *sometimes* — leave as
+            # generated; the oracle handles chain-open errors too.
+            transfer_batches.append(types.batch(batch, types.TRANSFER_DTYPE))
+        run_both(account_batches, transfer_batches)
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_random_simple_heavy(self, seed):
+        """Mostly-fast-path workload with occasional hard batches."""
+        rng = np.random.default_rng(1000 + seed)
+        accounts = simple_accounts(32)
+        batches = []
+        next_id = 1
+        for b in range(5):
+            bn = int(rng.integers(16, 64))
+            batch = []
+            for _ in range(bn):
+                batch.append(
+                    types.transfer(
+                        id=next_id,
+                        debit_account_id=int(rng.integers(1, 33)),
+                        credit_account_id=int(rng.integers(1, 33)),
+                        amount=int(rng.integers(1, 1000)),
+                        ledger=1,
+                        code=1,
+                        flags=int(TransferFlags.PENDING) if rng.random() < 0.2 else 0,
+                    )
+                )
+                next_id += 1
+            batches.append(types.batch(batch, types.TRANSFER_DTYPE))
+        sm, orc = run_both([accounts], batches)
+        assert sm.stats["fast_batches"] >= 3
+
+
+class TestReadOps:
+    def test_get_account_transfers(self):
+        accounts = simple_accounts(3)
+        transfers = types.batch(
+            [
+                types.transfer(id=i + 1, debit_account_id=1 + (i % 2), credit_account_id=3,
+                               amount=i + 1, ledger=1, code=1)
+                for i in range(10)
+            ],
+            types.TRANSFER_DTYPE,
+        )
+        sm, orc = run_both([accounts], [transfers])
+        from tigerbeetle_tpu.flags import AccountFilterFlags as FF
+
+        for aid in (1, 2, 3):
+            for flags in (FF.DEBITS, FF.CREDITS, FF.DEBITS | FF.CREDITS,
+                          FF.DEBITS | FF.CREDITS | FF.REVERSED):
+                got = sm.get_account_transfers(aid, flags=int(flags), limit=5)
+                want = orc.get_account_transfers(aid, flags=int(flags), limit=5)
+                assert len(got) == len(want)
+                for rec, t in zip(got, want):
+                    assert transfer_from_numpy(rec) == t
+
+    def test_lookup_missing(self):
+        sm = StateMachine(CFG)
+        out = sm.lookup_accounts(np.array([5], dtype=np.uint64), np.array([0], dtype=np.uint64))
+        assert len(out) == 0

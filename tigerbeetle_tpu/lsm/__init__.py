@@ -1,0 +1,11 @@
+"""Host-side LSM-shaped storage: sorted-run indexes and the transfer log.
+
+The reference's LSM forest (/root/reference/src/lsm/) is a disk-backed tree
+of sorted runs per groove. In the TPU build the mutable hot state (account
+balances) lives on-device (ops/commit.py); the host keeps the reference's
+*index* role — id → slot/row maps and secondary indexes — as vectorized
+sorted runs with geometric merging (the same memtable → immutable-run →
+leveled-merge shape as lsm/tree.zig, without the disk format yet).
+"""
+
+from tigerbeetle_tpu.lsm.store import U128Index, TransferLog  # noqa: F401

@@ -1,0 +1,139 @@
+"""Sorted-run u128 → u32 index and the append-only transfer log.
+
+Mirrors the reference LSM tree shape (/root/reference/src/lsm/tree.zig:
+mutable memtable → immutable runs → merged levels) with numpy-vectorized
+batch operations: inserts append to a memtable; when it fills, it is sorted
+into an immutable run; when runs pile up they are merged (np stable sort of
+the concatenation — the host analog of compaction.zig's k-way merge; the
+Pallas streaming-merge kernel replaces this for device-resident runs).
+
+Keys are u128 as structured (hi, lo) u64 pairs — numpy's structured compare
+gives exact lexicographic == numeric u128 order (no byte-string trailing-NUL
+pitfalls). All lookups are batch APIs (vectorized over whole 8190-event
+batches), matching the reference's prefetch design (groove.zig:644-909).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+KEY_DTYPE = np.dtype([("hi", "<u8"), ("lo", "<u8")])
+NOT_FOUND = np.uint32(0xFFFFFFFF)
+
+
+def pack_keys(lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
+    """(n,) u64 lo + hi → (n,) KEY_DTYPE with numeric u128 ordering."""
+    out = np.empty(len(lo), dtype=KEY_DTYPE)
+    out["hi"] = hi
+    out["lo"] = lo
+    return out
+
+
+class U128Index:
+    """Batched u128 → u32 map as sorted runs (keys are unique by contract).
+
+    insert_batch / lookup_batch are the only APIs — single-key operations
+    would serialize the hot path. `memtable_max` plays the role of the
+    reference's mutable-table size; `runs_max` of its level count before a
+    full merge (tree.zig / compaction.zig, radically simplified).
+    """
+
+    def __init__(self, memtable_max: int = 1 << 16, runs_max: int = 6) -> None:
+        self._mem: List[Tuple[np.ndarray, np.ndarray]] = []  # unsorted batches
+        self._mem_count = 0
+        self._runs: List[Tuple[np.ndarray, np.ndarray]] = []  # sorted (keys, vals)
+        self.memtable_max = memtable_max
+        self.runs_max = runs_max
+        self.count = 0
+
+    def insert_batch(self, keys: np.ndarray, values: np.ndarray) -> None:
+        if len(keys) == 0:
+            return
+        self._mem.append((keys, np.asarray(values, dtype=np.uint32)))
+        self._mem_count += len(keys)
+        self.count += len(keys)
+        if self._mem_count >= self.memtable_max:
+            self._flush_memtable()
+            if len(self._runs) > self.runs_max:
+                self._merge_runs()
+
+    def _flush_memtable(self) -> None:
+        keys = np.concatenate([k for k, _ in self._mem])
+        vals = np.concatenate([v for _, v in self._mem])
+        order = np.argsort(keys, kind="stable")
+        self._runs.append((keys[order], vals[order]))
+        self._mem = []
+        self._mem_count = 0
+
+    def _merge_runs(self) -> None:
+        keys = np.concatenate([k for k, _ in self._runs])
+        vals = np.concatenate([v for _, v in self._runs])
+        order = np.argsort(keys, kind="stable")
+        self._runs = [(keys[order], vals[order])]
+
+    def lookup_batch(self, keys: np.ndarray) -> np.ndarray:
+        """(n,) KEY_DTYPE → (n,) u32 values, NOT_FOUND where absent."""
+        n = len(keys)
+        out = np.full(n, NOT_FOUND, dtype=np.uint32)
+        if n == 0:
+            return out
+        for run_keys, run_vals in self._runs:
+            ix = np.searchsorted(run_keys, keys)
+            ix_c = np.minimum(ix, len(run_keys) - 1)
+            hit = (ix < len(run_keys)) & (run_keys[ix_c] == keys)
+            out[hit] = run_vals[ix_c[hit]]
+        for mem_keys, mem_vals in self._mem:
+            # Memtable batches are small and unsorted; sort queries instead.
+            order = np.argsort(mem_keys, kind="stable")
+            sk, sv = mem_keys[order], mem_vals[order]
+            ix = np.searchsorted(sk, keys)
+            ix_c = np.minimum(ix, len(sk) - 1)
+            hit = (ix < len(sk)) & (sk[ix_c] == keys)
+            out[hit] = sv[ix_c[hit]]
+        return out
+
+    def contains_any(self, keys: np.ndarray) -> bool:
+        return bool(np.any(self.lookup_batch(keys) != NOT_FOUND))
+
+
+class TransferLog:
+    """Append-only columnar log of committed transfers, in commit order.
+
+    Row index == insertion order; transfer timestamps are strictly
+    increasing with row (the reference's object tree is keyed by timestamp,
+    groove.zig:138 — commit order IS timestamp order). Records are stored as
+    the wire-layout structured dtype so lookups return byte-exact rows.
+    """
+
+    def __init__(self, dtype: np.dtype) -> None:
+        self.dtype = dtype
+        self._chunks: List[np.ndarray] = []
+        self._consolidated: Optional[np.ndarray] = None
+        self.count = 0
+
+    def append_batch(self, records: np.ndarray) -> np.ndarray:
+        """Append (k,) structured records; returns their row indices."""
+        rows = np.arange(self.count, self.count + len(records), dtype=np.uint32)
+        if len(records):
+            self._chunks.append(records.copy())
+            self._consolidated = None
+            self.count += len(records)
+        return rows
+
+    def _all(self) -> np.ndarray:
+        if self._consolidated is None:
+            if self._chunks:
+                self._consolidated = np.concatenate(self._chunks)
+                self._chunks = [self._consolidated]
+            else:
+                self._consolidated = np.zeros(0, dtype=self.dtype)
+        return self._consolidated
+
+    def gather(self, rows: np.ndarray) -> np.ndarray:
+        return self._all()[np.asarray(rows, dtype=np.int64)]
+
+    def scan(self) -> np.ndarray:
+        """Full columnar view for vectorized range/filter queries."""
+        return self._all()

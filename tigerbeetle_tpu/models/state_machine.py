@@ -1,0 +1,730 @@
+"""The accounting state machine: host orchestration over the TPU kernels.
+
+Re-expresses the reference StateMachine (/root/reference/src/state_machine.zig:34)
+TPU-first. The reference runs a serial per-event loop over an LSM
+(state_machine.zig:1002-1088); here:
+
+  - Account balances are device-resident uint32-limb arrays (ops/commit.py
+    LedgerState) — the "model weights" of the flagship kernel.
+  - The host resolves ids → slots/rows (the reference's *prefetch* phase,
+    state_machine.zig:514-655) using vectorized sorted-run indexes (lsm/).
+  - Each batch is classified: fast-path batches (no linked chains, no
+    post/void/balancing, no duplicate ids, no limit/history accounts
+    touched) commit via the fully-parallel device kernel
+    (ops/commit.create_transfers_fast); everything else runs through the
+    byte-exact serial oracle over lazily-prefetched state (the reference's
+    own execution order), then writes balances back to the device.
+
+Both paths produce byte-identical results to models/oracle.py — the property
+tests in tests/test_state_machine.py enforce this.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.constants import Config, PRODUCTION
+from tigerbeetle_tpu.flags import AccountFlags, TransferFlags
+from tigerbeetle_tpu.lsm.store import (
+    KEY_DTYPE,
+    NOT_FOUND,
+    TransferLog,
+    U128Index,
+    pack_keys,
+)
+from tigerbeetle_tpu.models import oracle as oracle_mod
+from tigerbeetle_tpu.models.oracle import Oracle
+from tigerbeetle_tpu.results import CreateAccountResult as AR
+from tigerbeetle_tpu.results import CreateTransferResult as TR
+
+U64_MAX = types.U64_MAX
+
+_HARD_TRANSFER_FLAGS = np.uint16(
+    TransferFlags.LINKED
+    | TransferFlags.POST_PENDING_TRANSFER
+    | TransferFlags.VOID_PENDING_TRANSFER
+    | TransferFlags.BALANCING_DEBIT
+    | TransferFlags.BALANCING_CREDIT
+)
+_HARD_ACCOUNT_FLAGS = np.uint32(
+    AccountFlags.DEBITS_MUST_NOT_EXCEED_CREDITS
+    | AccountFlags.CREDITS_MUST_NOT_EXCEED_DEBITS
+    | AccountFlags.HISTORY
+)
+
+
+class _LazyDict(dict):
+    """dict that faults entries in from a fetch function on miss.
+
+    Lets the serial oracle run against lazily-materialized store state; keys
+    it loaded (vs created) are tracked so writeback knows what is new.
+    """
+
+    def __init__(self, fetch) -> None:
+        super().__init__()
+        self._fetch = fetch
+        self.fetched_keys: set = set()
+
+    def get(self, k, default=None):
+        if dict.__contains__(self, k):
+            return dict.__getitem__(self, k)
+        v = self._fetch(k)
+        if v is None:
+            return default
+        self.fetched_keys.add(k)
+        dict.__setitem__(self, k, v)
+        return v
+
+    def __getitem__(self, k):
+        v = self.get(k)
+        if v is None:
+            raise KeyError(k)
+        return v
+
+    def __contains__(self, k) -> bool:
+        return self.get(k) is not None
+
+    def preload(self, k, v) -> None:
+        if not dict.__contains__(self, k):
+            self.fetched_keys.add(k)
+            dict.__setitem__(self, k, v)
+
+
+def _results_array(pairs: List[Tuple[int, int]]) -> np.ndarray:
+    out = np.zeros(len(pairs), dtype=types.EVENT_RESULT_DTYPE)
+    for i, (index, result) in enumerate(pairs):
+        out[i] = (index, result)
+    return out
+
+
+def _codes_to_results(codes: np.ndarray) -> np.ndarray:
+    nz = np.nonzero(codes)[0]
+    out = np.zeros(len(nz), dtype=types.EVENT_RESULT_DTYPE)
+    out["index"] = nz.astype(np.uint32)
+    out["result"] = codes[nz].astype(np.uint32)
+    return out
+
+
+class StateMachine:
+    """Single-replica accounting state machine (device-accelerated).
+
+    Operations mirror the reference's Operation enum
+    (state_machine.zig:318-326): create_accounts, create_transfers,
+    lookup_accounts, lookup_transfers, get_account_transfers,
+    get_account_history.
+    """
+
+    def __init__(self, config: Config = PRODUCTION, backend: str = "jax") -> None:
+        self.config = config
+        self.backend = backend
+        a = config.accounts_max
+
+        if backend == "jax":
+            from tigerbeetle_tpu.ops import commit as commit_ops
+
+            self._ops = commit_ops
+            self.state = commit_ops.init_state(a)
+        else:  # pure-host backend: balances live in numpy mirrors
+            self._ops = None
+            self._host_bal = {
+                name: np.zeros((a, 4), dtype=np.uint32)
+                for name in (
+                    "debits_pending", "debits_posted",
+                    "credits_pending", "credits_posted",
+                )
+            }
+
+        # Host mirrors of immutable per-account fields (slot-indexed).
+        self.acc_key = np.zeros(a, dtype=KEY_DTYPE)
+        self.acc_user_data_128_lo = np.zeros(a, dtype=np.uint64)
+        self.acc_user_data_128_hi = np.zeros(a, dtype=np.uint64)
+        self.acc_user_data_64 = np.zeros(a, dtype=np.uint64)
+        self.acc_user_data_32 = np.zeros(a, dtype=np.uint32)
+        self.acc_ledger = np.zeros(a, dtype=np.uint32)
+        self.acc_code = np.zeros(a, dtype=np.uint32)
+        self.acc_flags = np.zeros(a, dtype=np.uint32)
+        self.acc_timestamp = np.zeros(a, dtype=np.uint64)
+        self.account_count = 0
+
+        self.account_index = U128Index()
+        self.transfer_index = U128Index()
+        self.transfer_log = TransferLog(types.TRANSFER_DTYPE)
+        # pending-transfer timestamp → fulfillment (reference PostedGroove).
+        self.posted: Dict[int, int] = {}
+        self.history: List[oracle_mod.HistoryRow] = []
+
+        self.prepare_timestamp = 0
+        self.commit_timestamp = 0
+
+        # telemetry: how many batches took which path
+        self.stats = {"fast_batches": 0, "serial_batches": 0, "bail_batches": 0}
+
+    # ------------------------------------------------------------------
+    # prepare (timestamp assignment, reference state_machine.zig:503-511)
+
+    def prepare(self, operation: str, event_count: int) -> int:
+        if operation in ("create_accounts", "create_transfers"):
+            self.prepare_timestamp += event_count
+        return self.prepare_timestamp
+
+    # ------------------------------------------------------------------
+    # balances access (device or host backend)
+
+    def _read_balances(self, slots: np.ndarray):
+        if self._ops is not None:
+            dp, dpo, cp, cpo = self._ops.read_balances(
+                self.state, np.asarray(slots, dtype=np.int32)
+            )
+            return (np.asarray(dp), np.asarray(dpo), np.asarray(cp), np.asarray(cpo))
+        s = np.asarray(slots, dtype=np.int64)
+        hb = self._host_bal
+        return (
+            hb["debits_pending"][s], hb["debits_posted"][s],
+            hb["credits_pending"][s], hb["credits_posted"][s],
+        )
+
+    def _write_balances(self, slots, dp, dpo, cp, cpo) -> None:
+        if self._ops is not None:
+            self.state = self._ops.write_balances(
+                self.state, np.asarray(slots, dtype=np.int32), dp, dpo, cp, cpo
+            )
+        else:
+            s = np.asarray(slots, dtype=np.int64)
+            hb = self._host_bal
+            hb["debits_pending"][s] = dp
+            hb["debits_posted"][s] = dpo
+            hb["credits_pending"][s] = cp
+            hb["credits_posted"][s] = cpo
+
+    def _register_accounts(self, slots, ledger, flags, mask) -> None:
+        if self._ops is not None:
+            self.state = self._ops.register_accounts(
+                self.state,
+                np.asarray(slots, dtype=np.int32),
+                np.asarray(ledger, dtype=np.uint32),
+                np.asarray(flags, dtype=np.uint32),
+                np.asarray(mask),
+            )
+
+    # ------------------------------------------------------------------
+    # create_accounts
+
+    def create_accounts(self, events: np.ndarray, timestamp: Optional[int] = None) -> np.ndarray:
+        events = np.atleast_1d(events)
+        n = len(events)
+        if timestamp is None:
+            timestamp = self.prepare("create_accounts", n)
+        if n == 0:
+            return np.zeros(0, dtype=types.EVENT_RESULT_DTYPE)
+        ts = np.uint64(timestamp) - np.uint64(n) + 1 + np.arange(n, dtype=np.uint64)
+
+        flags = events["flags"].astype(np.uint32)
+        keys = pack_keys(events["id_lo"], events["id_hi"])
+
+        hard = bool(np.any(flags & np.uint32(AccountFlags.LINKED)))
+        if not hard:
+            order = np.lexsort((keys["lo"], keys["hi"]))
+            sk = keys[order]
+            hard = bool(np.any(sk[1:] == sk[:-1])) if n > 1 else False
+        if hard:
+            return self._create_accounts_serial(events, timestamp)
+
+        code = np.zeros(n, dtype=np.uint32)
+
+        def ladder(cond, result):
+            np.copyto(code, np.uint32(int(result)), where=(code == 0) & cond)
+
+        ladder(events["timestamp"] != 0, AR.TIMESTAMP_MUST_BE_ZERO)
+        ladder(events["reserved"] != 0, AR.RESERVED_FIELD)
+        ladder((flags & np.uint32(AccountFlags.padding_mask())) != 0, AR.RESERVED_FLAG)
+        id_zero = (events["id_lo"] == 0) & (events["id_hi"] == 0)
+        id_max = (events["id_lo"] == U64_MAX) & (events["id_hi"] == U64_MAX)
+        ladder(id_zero, AR.ID_MUST_NOT_BE_ZERO)
+        ladder(id_max, AR.ID_MUST_NOT_BE_INT_MAX)
+        both = np.uint32(
+            AccountFlags.DEBITS_MUST_NOT_EXCEED_CREDITS
+            | AccountFlags.CREDITS_MUST_NOT_EXCEED_DEBITS
+        )
+        ladder((flags & both) == both, AR.FLAGS_ARE_MUTUALLY_EXCLUSIVE)
+        ladder(
+            (events["debits_pending_lo"] != 0) | (events["debits_pending_hi"] != 0),
+            AR.DEBITS_PENDING_MUST_BE_ZERO,
+        )
+        ladder(
+            (events["debits_posted_lo"] != 0) | (events["debits_posted_hi"] != 0),
+            AR.DEBITS_POSTED_MUST_BE_ZERO,
+        )
+        ladder(
+            (events["credits_pending_lo"] != 0) | (events["credits_pending_hi"] != 0),
+            AR.CREDITS_PENDING_MUST_BE_ZERO,
+        )
+        ladder(
+            (events["credits_posted_lo"] != 0) | (events["credits_posted_hi"] != 0),
+            AR.CREDITS_POSTED_MUST_BE_ZERO,
+        )
+        ladder(events["ledger"] == 0, AR.LEDGER_MUST_NOT_BE_ZERO)
+        ladder(events["code"] == 0, AR.CODE_MUST_NOT_BE_ZERO)
+
+        # exists ladder (reference state_machine.zig _create_account_exists)
+        slots = self.account_index.lookup_batch(keys)
+        found = (slots != NOT_FOUND) & (code == 0)
+        if np.any(found):
+            s = slots[found].astype(np.int64)
+            fcode = np.zeros(len(s), dtype=np.uint32)
+
+            def fladder(cond, result):
+                np.copyto(fcode, np.uint32(int(result)), where=(fcode == 0) & cond)
+
+            fladder(flags[found] != self.acc_flags[s], AR.EXISTS_WITH_DIFFERENT_FLAGS)
+            fladder(
+                (events["user_data_128_lo"][found] != self.acc_user_data_128_lo[s])
+                | (events["user_data_128_hi"][found] != self.acc_user_data_128_hi[s]),
+                AR.EXISTS_WITH_DIFFERENT_USER_DATA_128,
+            )
+            fladder(
+                events["user_data_64"][found] != self.acc_user_data_64[s],
+                AR.EXISTS_WITH_DIFFERENT_USER_DATA_64,
+            )
+            fladder(
+                events["user_data_32"][found] != self.acc_user_data_32[s],
+                AR.EXISTS_WITH_DIFFERENT_USER_DATA_32,
+            )
+            fladder(events["ledger"][found] != self.acc_ledger[s], AR.EXISTS_WITH_DIFFERENT_LEDGER)
+            fladder(events["code"][found] != self.acc_code[s], AR.EXISTS_WITH_DIFFERENT_CODE)
+            fladder(np.ones(len(s), dtype=bool), AR.EXISTS)
+            code[found] = fcode
+
+        ok = code == 0
+        k = int(ok.sum())
+        if self.account_count + k > self.config.accounts_max:
+            raise RuntimeError("accounts table full (accounts_max exceeded)")
+        if k:
+            new_slots = np.arange(self.account_count, self.account_count + k, dtype=np.int64)
+            s_all = np.full(n, -1, dtype=np.int32)
+            s_all[ok] = new_slots
+            self.acc_key[new_slots] = keys[ok]
+            self.acc_user_data_128_lo[new_slots] = events["user_data_128_lo"][ok]
+            self.acc_user_data_128_hi[new_slots] = events["user_data_128_hi"][ok]
+            self.acc_user_data_64[new_slots] = events["user_data_64"][ok]
+            self.acc_user_data_32[new_slots] = events["user_data_32"][ok]
+            self.acc_ledger[new_slots] = events["ledger"][ok]
+            self.acc_code[new_slots] = events["code"][ok]
+            self.acc_flags[new_slots] = flags[ok]
+            self.acc_timestamp[new_slots] = ts[ok]
+            self.account_count += k
+            self.account_index.insert_batch(keys[ok], new_slots.astype(np.uint32))
+            self._register_accounts(s_all, events["ledger"].astype(np.uint32), flags, ok)
+            self.commit_timestamp = int(ts[ok][-1])
+        return _codes_to_results(code)
+
+    # ------------------------------------------------------------------
+    # create_transfers
+
+    def create_transfers(self, events: np.ndarray, timestamp: Optional[int] = None) -> np.ndarray:
+        events = np.atleast_1d(events)
+        n = len(events)
+        if timestamp is None:
+            timestamp = self.prepare("create_transfers", n)
+        if n == 0:
+            return np.zeros(0, dtype=types.EVENT_RESULT_DTYPE)
+        ts = np.uint64(timestamp) - np.uint64(n) + 1 + np.arange(n, dtype=np.uint64)
+
+        flags16 = events["flags"]
+        keys = pack_keys(events["id_lo"], events["id_hi"])
+
+        hard = bool(np.any(flags16 & _HARD_TRANSFER_FLAGS))
+        if not hard and n > 1:
+            order = np.lexsort((keys["lo"], keys["hi"]))
+            sk = keys[order]
+            hard = bool(np.any(sk[1:] == sk[:-1]))
+        if not hard:
+            hard = self.transfer_index.contains_any(keys)
+        if hard or self._ops is None:
+            self.stats["serial_batches"] += 1
+            return self._create_transfers_serial(events, timestamp)
+
+        dr_keys = pack_keys(events["debit_account_id_lo"], events["debit_account_id_hi"])
+        cr_keys = pack_keys(events["credit_account_id_lo"], events["credit_account_id_hi"])
+        dr_slots = self.account_index.lookup_batch(dr_keys).astype(np.int64)
+        cr_slots = self.account_index.lookup_batch(cr_keys).astype(np.int64)
+        dr_slots[dr_slots == int(NOT_FOUND)] = -1
+        cr_slots[cr_slots == int(NOT_FOUND)] = -1
+
+        touched = np.concatenate([dr_slots[dr_slots >= 0], cr_slots[cr_slots >= 0]])
+        if len(touched) and bool(np.any(self.acc_flags[touched] & _HARD_ACCOUNT_FLAGS)):
+            self.stats["serial_batches"] += 1
+            return self._create_transfers_serial(events, timestamp)
+
+        # Host-side rungs the device cannot evaluate (raw-id shape checks).
+        host_code = np.zeros(n, dtype=np.uint32)
+
+        def ladder(cond, result):
+            np.copyto(host_code, np.uint32(int(result)), where=(host_code == 0) & cond)
+
+        ladder(events["timestamp"] != 0, TR.TIMESTAMP_MUST_BE_ZERO)
+        dr_zero = (events["debit_account_id_lo"] == 0) & (events["debit_account_id_hi"] == 0)
+        dr_max = (events["debit_account_id_lo"] == U64_MAX) & (
+            events["debit_account_id_hi"] == U64_MAX
+        )
+        cr_zero = (events["credit_account_id_lo"] == 0) & (events["credit_account_id_hi"] == 0)
+        cr_max = (events["credit_account_id_lo"] == U64_MAX) & (
+            events["credit_account_id_hi"] == U64_MAX
+        )
+        same = (events["debit_account_id_lo"] == events["credit_account_id_lo"]) & (
+            events["debit_account_id_hi"] == events["credit_account_id_hi"]
+        )
+        # The device ladder checks RESERVED_FLAG/ID zero/max first; these
+        # rungs sit between them and the rest — the nonzero-minimum merge in
+        # the kernel puts every rung at its exact precedence position.
+        ladder(dr_zero, TR.DEBIT_ACCOUNT_ID_MUST_NOT_BE_ZERO)
+        ladder(dr_max, TR.DEBIT_ACCOUNT_ID_MUST_NOT_BE_INT_MAX)
+        ladder(cr_zero, TR.CREDIT_ACCOUNT_ID_MUST_NOT_BE_ZERO)
+        ladder(cr_max, TR.CREDIT_ACCOUNT_ID_MUST_NOT_BE_INT_MAX)
+        ladder(same, TR.ACCOUNTS_MUST_BE_DIFFERENT)
+
+        # Pad to a power-of-two bucket so the kernel compiles once per bucket
+        # size, not per batch length. Padding events carry a nonzero host code
+        # (never applied) and are stripped from the results.
+        n_pad = 1 << max(4, (n - 1).bit_length())
+
+        def pad1(a, fill=0):
+            if len(a) == n:
+                out = np.full((n_pad, *a.shape[1:]), fill, dtype=a.dtype)
+                out[:n] = a
+                return out
+            return a
+
+        host_code_p = pad1(host_code, fill=int(TR.ID_MUST_NOT_BE_ZERO))
+        b = self._ops.TransferBatch(
+            id=pad1(types.u64_pair_to_limbs(events["id_lo"], events["id_hi"])),
+            dr_slot=pad1(dr_slots.astype(np.int32), fill=-1),
+            cr_slot=pad1(cr_slots.astype(np.int32), fill=-1),
+            amount=pad1(types.u64_pair_to_limbs(events["amount_lo"], events["amount_hi"])),
+            pending_id=pad1(
+                types.u64_pair_to_limbs(events["pending_id_lo"], events["pending_id_hi"])
+            ),
+            timeout=pad1(events["timeout"].astype(np.uint32)),
+            ledger=pad1(events["ledger"].astype(np.uint32)),
+            code=pad1(events["code"].astype(np.uint32)),
+            flags=pad1(flags16.astype(np.uint32)),
+            timestamp=pad1(types.u64_to_limbs(ts)),
+        )
+        new_state, codes_dev, bail = self._ops.create_transfers_fast(self.state, b, host_code_p)
+        if bool(bail):
+            self.stats["bail_batches"] += 1
+            return self._create_transfers_serial(events, timestamp)
+        self.state = new_state
+        self.stats["fast_batches"] += 1
+        codes = np.asarray(codes_dev)[:n]
+
+        ok = codes == 0
+        if np.any(ok):
+            recs = events[ok].copy()
+            recs["timestamp"] = ts[ok]
+            rows = self.transfer_log.append_batch(recs)
+            self.transfer_index.insert_batch(keys[ok], rows)
+            self.commit_timestamp = int(ts[ok][-1])
+        return _codes_to_results(codes)
+
+    # ------------------------------------------------------------------
+    # serial (exact) path — runs the oracle over lazily-prefetched state
+
+    def _account_by_slot(self, slot: int, bal: Tuple) -> oracle_mod.Account:
+        key = self.acc_key[slot]
+        return oracle_mod.Account(
+            id=int(key["lo"]) | (int(key["hi"]) << 64),
+            debits_pending=bal[0],
+            debits_posted=bal[1],
+            credits_pending=bal[2],
+            credits_posted=bal[3],
+            user_data_128=int(self.acc_user_data_128_lo[slot])
+            | (int(self.acc_user_data_128_hi[slot]) << 64),
+            user_data_64=int(self.acc_user_data_64[slot]),
+            user_data_32=int(self.acc_user_data_32[slot]),
+            ledger=int(self.acc_ledger[slot]),
+            code=int(self.acc_code[slot]),
+            flags=int(self.acc_flags[slot]),
+            timestamp=int(self.acc_timestamp[slot]),
+        )
+
+    def _slot_of_id(self, ident: int) -> int:
+        keys = pack_keys(
+            np.array([ident & U64_MAX], dtype=np.uint64),
+            np.array([ident >> 64], dtype=np.uint64),
+        )
+        slot = self.account_index.lookup_batch(keys)[0]
+        return -1 if slot == NOT_FOUND else int(slot)
+
+    def _fetch_account(self, ident: int) -> Optional[oracle_mod.Account]:
+        slot = self._slot_of_id(ident)
+        if slot < 0:
+            return None
+        dp, dpo, cp, cpo = self._read_balances(np.array([slot]))
+        bal = (
+            types.limbs_to_int(dp[0]), types.limbs_to_int(dpo[0]),
+            types.limbs_to_int(cp[0]), types.limbs_to_int(cpo[0]),
+        )
+        return self._account_by_slot(slot, bal)
+
+    def _fetch_transfer(self, ident: int) -> Optional[oracle_mod.Transfer]:
+        keys = pack_keys(
+            np.array([ident & U64_MAX], dtype=np.uint64),
+            np.array([ident >> 64], dtype=np.uint64),
+        )
+        row = self.transfer_index.lookup_batch(keys)[0]
+        if row == NOT_FOUND:
+            return None
+        rec = self.transfer_log.gather(np.array([row]))[0]
+        return oracle_mod.transfer_from_numpy(rec)
+
+    def _preload_accounts(self, orc: Oracle, keys: np.ndarray) -> None:
+        """Batch-prefetch accounts by packed keys into the oracle's lazy dict."""
+        if len(keys) == 0:
+            return
+        slots = self.account_index.lookup_batch(keys)
+        found = slots != NOT_FOUND
+        if not np.any(found):
+            return
+        s = slots[found].astype(np.int64)
+        s_unique = np.unique(s)
+        dp, dpo, cp, cpo = self._read_balances(s_unique)
+        for i, slot in enumerate(s_unique):
+            bal = (
+                types.limbs_to_int(dp[i]), types.limbs_to_int(dpo[i]),
+                types.limbs_to_int(cp[i]), types.limbs_to_int(cpo[i]),
+            )
+            acct = self._account_by_slot(int(slot), bal)
+            orc.accounts.preload(acct.id, acct)
+
+    def _make_oracle(self) -> Oracle:
+        orc = Oracle()
+        orc.accounts = _LazyDict(self._fetch_account)
+        orc.transfers = _LazyDict(self._fetch_transfer)
+        orc.posted = self.posted
+        orc.history = self.history
+        orc.prepare_timestamp = self.prepare_timestamp
+        orc.commit_timestamp = self.commit_timestamp
+        return orc
+
+    def _writeback_accounts(self, orc: Oracle) -> None:
+        ids = list(dict.keys(orc.accounts))
+        if not ids:
+            return
+        keys = pack_keys(
+            np.array([i & U64_MAX for i in ids], dtype=np.uint64),
+            np.array([i >> 64 for i in ids], dtype=np.uint64),
+        )
+        slots = self.account_index.lookup_batch(keys)
+        assert not np.any(slots == NOT_FOUND), "serial path cannot touch unknown accounts"
+        dps, dpos, cps, cpos = [], [], [], []
+        for ident in ids:
+            a = dict.__getitem__(orc.accounts, ident)
+            dps.append(types.int_to_limbs(a.debits_pending))
+            dpos.append(types.int_to_limbs(a.debits_posted))
+            cps.append(types.int_to_limbs(a.credits_pending))
+            cpos.append(types.int_to_limbs(a.credits_posted))
+        self._write_balances(
+            slots.astype(np.int32),
+            np.stack(dps), np.stack(dpos), np.stack(cps), np.stack(cpos),
+        )
+
+    def _create_transfers_serial(self, events: np.ndarray, timestamp: int) -> np.ndarray:
+        orc = self._make_oracle()
+        # Prefetch round 1: dr/cr accounts, existing transfers by event id
+        # and by pending_id (reference prefetch, state_machine.zig:560-655).
+        acct_keys = np.concatenate([
+            pack_keys(events["debit_account_id_lo"], events["debit_account_id_hi"]),
+            pack_keys(events["credit_account_id_lo"], events["credit_account_id_hi"]),
+        ])
+        xfer_keys = np.concatenate([
+            pack_keys(events["id_lo"], events["id_hi"]),
+            pack_keys(events["pending_id_lo"], events["pending_id_hi"]),
+        ])
+        rows = self.transfer_index.lookup_batch(xfer_keys)
+        found_rows = np.unique(rows[rows != NOT_FOUND])
+        pend_acct_keys = np.zeros(0, dtype=acct_keys.dtype)
+        if len(found_rows):
+            recs = self.transfer_log.gather(found_rows)
+            for rec in recs:
+                orc.transfers.preload(
+                    types.u128_of(rec, "id"), oracle_mod.transfer_from_numpy(rec)
+                )
+            # Prefetch round 2: accounts referenced by prefetched (pending)
+            # transfers — post/void resolves p.debit/credit_account_id.
+            pend_acct_keys = np.concatenate([
+                pack_keys(recs["debit_account_id_lo"], recs["debit_account_id_hi"]),
+                pack_keys(recs["credit_account_id_lo"], recs["credit_account_id_hi"]),
+            ])
+        self._preload_accounts(orc, np.concatenate([acct_keys, pend_acct_keys]))
+
+        ev_objs = [oracle_mod.transfer_from_numpy(events[i]) for i in range(len(events))]
+        pairs = orc.create_transfers(ev_objs, timestamp)
+
+        # Writeback: balances to the device, new transfers to the log.
+        self._writeback_accounts(orc)
+        new_ids = [
+            i for i in dict.keys(orc.transfers) if i not in orc.transfers.fetched_keys
+        ]
+        if new_ids:
+            new_ts = sorted(new_ids, key=lambda i: dict.__getitem__(orc.transfers, i).timestamp)
+            recs = np.concatenate([
+                np.atleast_1d(oracle_mod.transfer_to_numpy(dict.__getitem__(orc.transfers, i)))
+                for i in new_ts
+            ])
+            rows = self.transfer_log.append_batch(recs)
+            self.transfer_index.insert_batch(
+                pack_keys(recs["id_lo"], recs["id_hi"]), rows
+            )
+        self.commit_timestamp = orc.commit_timestamp
+        return _results_array(pairs)
+
+    def _create_accounts_serial(self, events: np.ndarray, timestamp: int) -> np.ndarray:
+        orc = self._make_oracle()
+        self._preload_accounts(orc, pack_keys(events["id_lo"], events["id_hi"]))
+        ev_objs = [oracle_mod.account_from_numpy(events[i]) for i in range(len(events))]
+        pairs = orc.create_accounts(ev_objs, timestamp)
+
+        new_ids = [
+            i for i in dict.keys(orc.accounts) if i not in orc.accounts.fetched_keys
+        ]
+        if new_ids:
+            new_sorted = sorted(
+                new_ids, key=lambda i: dict.__getitem__(orc.accounts, i).timestamp
+            )
+            k = len(new_sorted)
+            if self.account_count + k > self.config.accounts_max:
+                raise RuntimeError("accounts table full (accounts_max exceeded)")
+            slots = np.arange(self.account_count, self.account_count + k, dtype=np.int64)
+            ledgers = np.zeros(k, dtype=np.uint32)
+            aflags = np.zeros(k, dtype=np.uint32)
+            lo = np.zeros(k, dtype=np.uint64)
+            hi = np.zeros(k, dtype=np.uint64)
+            for j, ident in enumerate(new_sorted):
+                a = dict.__getitem__(orc.accounts, ident)
+                slot = int(slots[j])
+                lo[j] = a.id & U64_MAX
+                hi[j] = a.id >> 64
+                self.acc_user_data_128_lo[slot] = a.user_data_128 & U64_MAX
+                self.acc_user_data_128_hi[slot] = a.user_data_128 >> 64
+                self.acc_user_data_64[slot] = a.user_data_64
+                self.acc_user_data_32[slot] = a.user_data_32
+                self.acc_ledger[slot] = a.ledger
+                self.acc_code[slot] = a.code
+                self.acc_flags[slot] = a.flags
+                self.acc_timestamp[slot] = a.timestamp
+                ledgers[j] = a.ledger
+                aflags[j] = a.flags
+            keys = pack_keys(lo, hi)
+            self.acc_key[slots] = keys
+            self.account_count += k
+            self.account_index.insert_batch(keys, slots.astype(np.uint32))
+            self._register_accounts(
+                slots.astype(np.int32), ledgers, aflags, np.ones(k, dtype=bool)
+            )
+        # Existing accounts are never mutated by create_accounts; only new
+        # ones appear — nothing else to write back.
+        self.commit_timestamp = orc.commit_timestamp
+        return _results_array(pairs)
+
+    # ------------------------------------------------------------------
+    # read operations
+
+    def lookup_accounts(self, ids_lo: np.ndarray, ids_hi: np.ndarray) -> np.ndarray:
+        keys = pack_keys(
+            np.asarray(ids_lo, dtype=np.uint64), np.asarray(ids_hi, dtype=np.uint64)
+        )
+        slots = self.account_index.lookup_batch(keys)
+        found = slots != NOT_FOUND
+        s = slots[found].astype(np.int64)
+        out = np.zeros(len(s), dtype=types.ACCOUNT_DTYPE)
+        if len(s) == 0:
+            return out
+        dp, dpo, cp, cpo = self._read_balances(s)
+        dp_lo, dp_hi = types.limbs_to_u64_pair(dp)
+        dpo_lo, dpo_hi = types.limbs_to_u64_pair(dpo)
+        cp_lo, cp_hi = types.limbs_to_u64_pair(cp)
+        cpo_lo, cpo_hi = types.limbs_to_u64_pair(cpo)
+        out["id_lo"] = self.acc_key["lo"][s]
+        out["id_hi"] = self.acc_key["hi"][s]
+        out["debits_pending_lo"], out["debits_pending_hi"] = dp_lo, dp_hi
+        out["debits_posted_lo"], out["debits_posted_hi"] = dpo_lo, dpo_hi
+        out["credits_pending_lo"], out["credits_pending_hi"] = cp_lo, cp_hi
+        out["credits_posted_lo"], out["credits_posted_hi"] = cpo_lo, cpo_hi
+        out["user_data_128_lo"] = self.acc_user_data_128_lo[s]
+        out["user_data_128_hi"] = self.acc_user_data_128_hi[s]
+        out["user_data_64"] = self.acc_user_data_64[s]
+        out["user_data_32"] = self.acc_user_data_32[s]
+        out["ledger"] = self.acc_ledger[s]
+        out["code"] = self.acc_code[s]
+        out["flags"] = self.acc_flags[s]
+        out["timestamp"] = self.acc_timestamp[s]
+        return out
+
+    def lookup_transfers(self, ids_lo: np.ndarray, ids_hi: np.ndarray) -> np.ndarray:
+        keys = pack_keys(
+            np.asarray(ids_lo, dtype=np.uint64), np.asarray(ids_hi, dtype=np.uint64)
+        )
+        rows = self.transfer_index.lookup_batch(keys)
+        found = rows != NOT_FOUND
+        return self.transfer_log.gather(rows[found])
+
+    def get_account_transfers(
+        self,
+        account_id: int,
+        timestamp_min: int = 0,
+        timestamp_max: int = 0,
+        limit: int = 8190,
+        flags: int = 0x3,
+    ) -> np.ndarray:
+        from tigerbeetle_tpu.flags import AccountFilterFlags as FF
+
+        if not Oracle._filter_valid(account_id, timestamp_min, timestamp_max, limit, flags):
+            return np.zeros(0, dtype=types.TRANSFER_DTYPE)
+        t = self.transfer_log.scan()
+        ts_min = np.uint64(timestamp_min if timestamp_min else 1)
+        ts_max = np.uint64(timestamp_max if timestamp_max else U64_MAX - 1)
+        lo = np.uint64(account_id & U64_MAX)
+        hi = np.uint64(account_id >> 64)
+        mask = (t["timestamp"] >= ts_min) & (t["timestamp"] <= ts_max)
+        m_dr = (t["debit_account_id_lo"] == lo) & (t["debit_account_id_hi"] == hi)
+        m_cr = (t["credit_account_id_lo"] == lo) & (t["credit_account_id_hi"] == hi)
+        side = np.zeros(len(t), dtype=bool)
+        if flags & FF.DEBITS:
+            side |= m_dr
+        if flags & FF.CREDITS:
+            side |= m_cr
+        rows = np.nonzero(mask & side)[0]
+        if flags & FF.REVERSED:
+            rows = rows[::-1]
+        return t[rows[:limit]]
+
+    def get_account_history(
+        self,
+        account_id: int,
+        timestamp_min: int = 0,
+        timestamp_max: int = 0,
+        limit: int = 8190,
+        flags: int = 0x3,
+    ) -> List[Tuple[int, int, int, int, int]]:
+        # History batches are always serial-path; delegate to oracle logic
+        # over the shared history list.
+        orc = self._make_oracle()
+        self._preload_accounts(
+            orc,
+            pack_keys(
+                np.array([account_id & U64_MAX], dtype=np.uint64),
+                np.array([account_id >> 64], dtype=np.uint64),
+            ),
+        )
+        # The oracle scans transfers by timestamp; provide a view over the log.
+        t = self.transfer_log.scan()
+        by_ts = {}
+        for row in self.history:
+            ix = np.searchsorted(t["timestamp"], np.uint64(row.timestamp))
+            if ix < len(t) and t["timestamp"][ix] == row.timestamp:
+                by_ts[row.timestamp] = oracle_mod.transfer_from_numpy(t[ix])
+        orc.transfers.update({tr.id: tr for tr in by_ts.values()})
+        return orc.get_account_history(account_id, timestamp_min, timestamp_max, limit, flags)

@@ -168,6 +168,69 @@ def mul_u32(a, b):
     return jnp.stack([lo, hi], axis=-1)
 
 
+def split_u16(limbs):
+    """(..., W) uint32 limbs → (..., 2W) uint32 holding u16 half-limbs.
+
+    Half-limbs are < 2^16, so a sum of up to 2^16 of them fits in uint32
+    without wrapping — the carry-safe accumulation format for segment-sum /
+    scatter-add (TPU has no u64 accumulators).
+    """
+    lo = limbs & jnp.uint32(0xFFFF)
+    hi = limbs >> 16
+    w = limbs.shape[-1]
+    parts = []
+    for i in range(w):
+        parts.append(lo[..., i])
+        parts.append(hi[..., i])
+    return jnp.stack(parts, axis=-1)
+
+
+def combine_u16(halves):
+    """(..., 2W) uint32 u16-half accumulators → ((..., W) uint32 limbs, overflow).
+
+    Propagates carries across half-limbs; each accumulator may hold up to
+    ~2^29, so the carry into the next half is `>> 16`.
+    """
+    w2 = halves.shape[-1]
+    w = w2 // 2
+    out = []
+    carry = jnp.zeros(halves.shape[:-1], dtype=U32)
+    for i in range(w):
+        lo = halves[..., 2 * i] + carry
+        carry = lo >> 16
+        lo = lo & jnp.uint32(0xFFFF)
+        hi = halves[..., 2 * i + 1] + carry
+        carry = hi >> 16
+        hi = hi & jnp.uint32(0xFFFF)
+        out.append(lo | (hi << 16))
+    return jnp.stack(out, axis=-1), (carry != 0)
+
+
+def scatter_add(table, slots, values, mask):
+    """table (A, W) += values (n, W) at rows `slots` (n,) where mask (n,).
+
+    Exact wide-integer scatter-add: values are split into u16 half-limbs so
+    per-slot accumulation cannot wrap uint32 (n ≤ 8190 < 2^16 contributions),
+    then recombined with carry propagation and added to the table. Returns
+    (new_table, overflow_mask (A,)) where overflow means the slot's total
+    exceeded the limb width (reference sum_overflows, state_machine.zig:1645).
+    """
+    a, w = table.shape
+    n = slots.shape[0]
+    # Exactness precondition: each u16 half-accumulator receives at most
+    # n * 0xFFFF, which must not wrap uint32.
+    assert n < (1 << 16), "scatter_add exactness requires n < 2^16"
+    halves = split_u16(values)
+    halves = jnp.where(mask[:, None], halves, jnp.zeros_like(halves))
+    safe_slots = jnp.where(mask, slots, 0).astype(jnp.int32)
+    acc = jnp.zeros((a, 2 * w), dtype=U32).at[safe_slots].add(
+        halves, mode="drop", indices_are_sorted=False, unique_indices=False
+    )
+    delta, delta_over = combine_u16(acc)
+    new_table, over = add(table, delta)
+    return new_table, (over | delta_over)
+
+
 def to_ints(limbs) -> list[int] | int:
     """Device/host limb array → Python int(s) (test helper)."""
     import numpy as np
