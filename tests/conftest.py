@@ -1,18 +1,23 @@
 """Test configuration: force an 8-device virtual CPU platform.
 
 Multi-chip hardware is not available in CI; sharding tests run on a virtual
-8-device CPU mesh (same XLA partitioner as real TPU). Must run before jax
-initializes, hence the env mutation at import time.
+8-device CPU mesh (same XLA partitioner as real TPU). The axon sitecustomize
+imports jax at interpreter start, so mutating JAX_PLATFORMS here is too late
+— instead XLA_FLAGS is set before the CPU client initializes (first device
+use) and the platform is switched via jax.config, which works post-import.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -94,3 +94,42 @@ def test_mul_u32(rng):
 def test_from_int_roundtrip():
     for v in EDGE:
         assert w.to_ints(w.from_int(v)) == v
+
+
+class TestPostingPaths:
+    """apply_posting_compact must match apply_posting_streamed exactly."""
+
+    def test_compact_streamed_parity(self):
+        import numpy as np
+
+        from tigerbeetle_tpu import types
+        from tigerbeetle_tpu.ops import commit as commit_ops
+
+        rng = np.random.default_rng(5)
+        a, n = 512, 128
+        state = commit_ops.init_state(a)
+        state = commit_ops.register_accounts(
+            state,
+            np.arange(a, dtype=np.int32),
+            np.ones(a, dtype=np.uint32),
+            np.zeros(a, dtype=np.uint32),
+            np.ones(a, dtype=bool),
+        )
+        dr = rng.integers(0, a, n).astype(np.int32)
+        cr = rng.integers(0, a, n).astype(np.int32)
+        amount = types.u64_pair_to_limbs(
+            rng.integers(1, 1 << 40, n).astype(np.uint64), np.zeros(n, dtype=np.uint64)
+        )
+        pend = rng.random(n) < 0.4
+        post = ~pend & (rng.random(n) < 0.8)  # some events inactive on both
+
+        s1, o1 = commit_ops.apply_posting_streamed(
+            state, dr, cr, amount,
+            dr_pend=pend, dr_post=post, cr_pend=pend, cr_post=post,
+        )
+        s2, o2 = commit_ops.apply_posting_compact(state, dr, cr, amount, pend, post)
+        assert bool(o1) == bool(o2)
+        for f in ("debits_pending", "debits_posted", "credits_pending", "credits_posted"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(s1, f)), np.asarray(getattr(s2, f)), err_msg=f
+            )

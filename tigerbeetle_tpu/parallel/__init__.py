@@ -1,0 +1,14 @@
+"""Multi-chip parallelism: mesh construction and the sharded commit step.
+
+The reference's only multi-node axis is replication for fault tolerance
+(SURVEY.md §2 parallelism notes) — every replica executes every op. The TPU
+build adds *intra-replica* scale-out: one logical replica's ledger state is
+sharded over a device mesh, so a single replica can hold and commit against
+state larger than one chip's HBM, at ICI bandwidth.
+"""
+
+from tigerbeetle_tpu.parallel.sharding import (  # noqa: F401
+    make_mesh,
+    init_sharded_state,
+    make_sharded_commit,
+)
