@@ -25,12 +25,13 @@ absolute numbers — BASELINE.md). North star: 5M/s (BASELINE.json).
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, __file__.rsplit("/", 1)[0])
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_TPS = 1_000_000.0
 

@@ -1,0 +1,211 @@
+"""Cluster tests: replication, view change, crash/recovery, checkpointing.
+
+The analog of /root/reference/src/vsr/replica_test.zig scenarios over the
+in-process simulated cluster (tests/conftest forces the CPU platform; the
+numpy state-machine backend keeps these deterministic and fast).
+"""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.testing.cluster import (
+    Cluster,
+    account_batch,
+    parse_results,
+    transfer_batch,
+)
+from tigerbeetle_tpu.vsr.header import Operation
+
+
+def do_request(cluster, client, operation, body, max_ticks=20_000):
+    client.request(operation, body)
+    cluster.run_until(lambda: client.idle, max_ticks)
+    return client.replies[-1]
+
+
+def setup_client(cluster, cid=100):
+    c = cluster.clients[cid]
+    c.register()
+    cluster.run_until(lambda: c.registered)
+    return c
+
+
+class TestSingleReplica:
+    def test_create_and_lookup(self):
+        cl = Cluster(replica_count=1)
+        c = setup_client(cl)
+        r = do_request(cl, c, Operation.CREATE_ACCOUNTS, account_batch([1, 2]))
+        assert len(parse_results(r)) == 0
+        r = do_request(
+            cl, c, Operation.CREATE_TRANSFERS,
+            transfer_batch([
+                dict(id=1, debit_account_id=1, credit_account_id=2, amount=100,
+                     ledger=1, code=1),
+            ]),
+        )
+        assert len(parse_results(r)) == 0
+        ids = np.zeros(2, dtype=types.ID_DTYPE)
+        ids["lo"] = [1, 2]
+        r = do_request(cl, c, Operation.LOOKUP_ACCOUNTS, ids.tobytes())
+        accounts = np.frombuffer(bytearray(r.body), dtype=types.ACCOUNT_DTYPE)
+        assert len(accounts) == 2
+        assert types.u128_of(accounts[0], "debits_posted") == 100
+        assert types.u128_of(accounts[1], "credits_posted") == 100
+
+    def test_restart_recovers_state(self):
+        cl = Cluster(replica_count=1)
+        c = setup_client(cl)
+        do_request(cl, c, Operation.CREATE_ACCOUNTS, account_batch([1, 2]))
+        do_request(
+            cl, c, Operation.CREATE_TRANSFERS,
+            transfer_batch([
+                dict(id=7, debit_account_id=1, credit_account_id=2, amount=55,
+                     ledger=1, code=1),
+            ]),
+        )
+        cl.storages[0].sync()
+        cl.crash_replica(0)
+        cl.restart_replica(0)
+        r0 = cl.replicas[0]
+        assert r0.commit_min >= 3  # register + 2 ops re-executed
+        out = r0.state_machine.lookup_accounts(
+            np.array([1], dtype=np.uint64), np.array([0], dtype=np.uint64)
+        )
+        assert types.u128_of(out[0], "debits_posted") == 55
+
+    def test_checkpoint_and_recovery_beyond_wal(self):
+        # Force ops past checkpoint_interval (16 in TEST_MIN) so recovery
+        # must start from the snapshot, then replay WAL.
+        cl = Cluster(replica_count=1)
+        c = setup_client(cl)
+        do_request(cl, c, Operation.CREATE_ACCOUNTS, account_batch([1, 2]))
+        for i in range(20):
+            do_request(
+                cl, c, Operation.CREATE_TRANSFERS,
+                transfer_batch([
+                    dict(id=10 + i, debit_account_id=1, credit_account_id=2,
+                         amount=1, ledger=1, code=1),
+                ]),
+            )
+        r0 = cl.replicas[0]
+        assert r0.superblock.state.op_checkpoint >= 16
+        cl.storages[0].sync()
+        cl.crash_replica(0)
+        cl.restart_replica(0)
+        r0 = cl.replicas[0]
+        out = r0.state_machine.lookup_accounts(
+            np.array([1], dtype=np.uint64), np.array([0], dtype=np.uint64)
+        )
+        assert types.u128_of(out[0], "debits_posted") == 20
+
+
+class TestReplicated:
+    @pytest.mark.parametrize("n", [2, 3, 5])
+    def test_replicated_commit_convergence(self, n):
+        cl = Cluster(replica_count=n)
+        c = setup_client(cl)
+        do_request(cl, c, Operation.CREATE_ACCOUNTS, account_batch([1, 2]))
+        for i in range(5):
+            do_request(
+                cl, c, Operation.CREATE_TRANSFERS,
+                transfer_batch([
+                    dict(id=1 + i, debit_account_id=1, credit_account_id=2,
+                         amount=10, ledger=1, code=1),
+                ]),
+            )
+        # Let heartbeats propagate commits to backups.
+        cl.run_until(
+            lambda: all(r.commit_min >= 7 for r in cl.replicas), 30_000
+        )
+        assert cl.check_state_convergence() >= 7
+
+    def test_lossy_network_convergence(self):
+        cl = Cluster(replica_count=3, seed=7, loss=0.05)
+        c = setup_client(cl)
+        do_request(cl, c, Operation.CREATE_ACCOUNTS, account_batch([1, 2]), 60_000)
+        for i in range(3):
+            do_request(
+                cl, c, Operation.CREATE_TRANSFERS,
+                transfer_batch([
+                    dict(id=1 + i, debit_account_id=1, credit_account_id=2,
+                         amount=10, ledger=1, code=1),
+                ]),
+                60_000,
+            )
+        cl.run_until(
+            lambda: all(r.commit_min >= 5 for r in cl.replicas), 60_000
+        )
+        assert cl.check_state_convergence() >= 5
+
+    def test_primary_crash_view_change(self):
+        cl = Cluster(replica_count=3)
+        c = setup_client(cl)
+        do_request(cl, c, Operation.CREATE_ACCOUNTS, account_batch([1, 2]))
+        old_primary = next(r for r in cl.replicas if r.is_primary)
+        cl.crash_replica(old_primary.replica)
+        # The survivors should elect a new primary and keep serving.
+        cl.run_until(
+            lambda: any(
+                r is not None and r.is_primary for r in cl.replicas
+            ) and all(
+                r is None or r.status == "normal" for r in cl.replicas
+            ),
+            60_000,
+        )
+        r = do_request(
+            cl, c, Operation.CREATE_TRANSFERS,
+            transfer_batch([
+                dict(id=99, debit_account_id=1, credit_account_id=2, amount=5,
+                     ledger=1, code=1),
+            ]),
+            60_000,
+        )
+        assert len(parse_results(r)) == 0
+        live = [r for r in cl.replicas if r is not None]
+        cl.run_until(lambda: all(x.commit_min >= 3 for x in live), 60_000)
+        assert cl.check_state_convergence() >= 3
+
+    def test_crashed_backup_rejoins(self):
+        cl = Cluster(replica_count=3)
+        c = setup_client(cl)
+        do_request(cl, c, Operation.CREATE_ACCOUNTS, account_batch([1, 2]))
+        backup = next(r for r in cl.replicas if not r.is_primary)
+        bi = backup.replica
+        cl.storages[bi].sync()
+        cl.crash_replica(bi)
+        for i in range(4):
+            do_request(
+                cl, c, Operation.CREATE_TRANSFERS,
+                transfer_batch([
+                    dict(id=1 + i, debit_account_id=1, credit_account_id=2,
+                         amount=10, ledger=1, code=1),
+                ]),
+            )
+        cl.restart_replica(bi)
+        cl.run_until(
+            lambda: all(r.commit_min >= 6 for r in cl.replicas), 60_000
+        )
+        assert cl.check_state_convergence() >= 6
+
+    def test_determinism_same_seed(self):
+        def run(seed):
+            cl = Cluster(replica_count=3, seed=seed, loss=0.02)
+            c = setup_client(cl)
+            do_request(cl, c, Operation.CREATE_ACCOUNTS, account_batch([1, 2]), 60_000)
+            do_request(
+                cl, c, Operation.CREATE_TRANSFERS,
+                transfer_batch([
+                    dict(id=1, debit_account_id=1, credit_account_id=2, amount=3,
+                         ledger=1, code=1),
+                ]),
+                60_000,
+            )
+            cl.run(500)
+            return (
+                cl.net.stats["sent"],
+                [r.commit_min for r in cl.replicas],
+                [r.commit_checksums.get(2) for r in cl.replicas],
+            )
+
+        assert run(12) == run(12)

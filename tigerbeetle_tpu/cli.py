@@ -1,0 +1,317 @@
+"""CLI: format | start | version | repl | benchmark.
+
+The operator surface (reference src/tigerbeetle/main.zig:56-66 + cli.zig +
+repl.zig + benchmark_driver.zig). Run as `python -m tigerbeetle_tpu.cli`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+import time
+from typing import List, Tuple
+
+VERSION = "0.1.0"
+
+
+def parse_addresses(s: str) -> List[Tuple[str, int]]:
+    out = []
+    for part in s.split(","):
+        part = part.strip()
+        if ":" in part:
+            host, port = part.rsplit(":", 1)
+        else:
+            host, port = "127.0.0.1", part
+        out.append((host or "127.0.0.1", int(port)))
+    return out
+
+
+def cmd_format(args) -> int:
+    from tigerbeetle_tpu.constants import config_by_name
+    from tigerbeetle_tpu.io.storage import FileStorage, Zone
+    from tigerbeetle_tpu.vsr.replica import Replica
+
+    config = config_by_name(args.config)
+    zone = Zone.for_config(
+        config.journal_slot_count, config.message_size_max, config.clients_max
+    )
+    storage = FileStorage(args.path, size=zone.total_size, create=True)
+    Replica.format(storage, zone, args.cluster, args.replica, args.replica_count)
+    storage.close()
+    print(f"formatted {args.path}: cluster={args.cluster} "
+          f"replica={args.replica}/{args.replica_count} config={config.name}")
+    return 0
+
+
+class FileSnapshotStore:
+    def __init__(self, path: str) -> None:
+        self.path = path + ".snapshot"
+
+    def save(self, blob: bytes) -> None:
+        import os
+
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def load(self):
+        try:
+            with open(self.path, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+
+def cmd_start(args) -> int:
+    from tigerbeetle_tpu.constants import config_by_name
+    from tigerbeetle_tpu.io.storage import FileStorage, Zone
+    from tigerbeetle_tpu.net.bus import ReplicaServer
+    from tigerbeetle_tpu.vsr.replica import Replica
+
+    config = config_by_name(args.config)
+    zone = Zone.for_config(
+        config.journal_slot_count, config.message_size_max, config.clients_max
+    )
+    addresses = parse_addresses(args.addresses)
+    storage = FileStorage(args.path)
+
+    class _NullBus:
+        def send_to_replica(self, r, m):
+            pass
+
+        def send_to_client(self, c, m):
+            pass
+
+    replica = Replica(
+        cluster=args.cluster,
+        replica_index=args.replica,
+        replica_count=len(addresses),
+        storage=storage,
+        zone=zone,
+        config=config,
+        bus=_NullBus(),
+        snapshot_store=FileSnapshotStore(args.path),
+        sm_backend=args.backend,
+    )
+    server = ReplicaServer(replica, addresses)
+    replica.open()
+    host, port = addresses[args.replica]
+    print(f"replica {args.replica}/{len(addresses)} listening on {host}:{port} "
+          f"(backend={args.backend}, status={replica.status})", flush=True)
+    try:
+        asyncio.run(server.serve_forever())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_repl(args) -> int:
+    """Interactive REPL (reference src/repl.zig statement grammar subset):
+        create_accounts id=1 ledger=1 code=10;
+        create_transfers id=1 debit_account_id=1 credit_account_id=2
+                         amount=10 ledger=1 code=1;
+        lookup_accounts id=1, id=2;
+        get_account_transfers account_id=1;
+    """
+    from tigerbeetle_tpu import types
+    from tigerbeetle_tpu.client import Client
+
+    client = Client(parse_addresses(args.addresses), cluster=args.cluster)
+    print(f"connected; session {hex(client.id)[:14]}…  (ctrl-d to exit)")
+    buf = ""
+    while True:
+        try:
+            line = input("> " if not buf else ". ")
+        except EOFError:
+            print()
+            return 0
+        buf += " " + line
+        if ";" not in buf:
+            continue
+        stmt, buf = buf.split(";", 1)
+        tokens = stmt.split()
+        if not tokens:
+            continue
+        op, fields = tokens[0], tokens[1:]
+        try:
+            _repl_execute(client, op, " ".join(fields), types)
+        except Exception as e:  # noqa: BLE001 — REPL surfaces all errors
+            print(f"error: {e}")
+
+
+def _repl_execute(client, op: str, rest: str, types) -> None:
+    import numpy as np
+
+    def parse_objects(text: str) -> List[dict]:
+        out = []
+        for chunk in text.split(","):
+            chunk = chunk.strip()
+            if not chunk:
+                continue
+            obj = {}
+            for kv in chunk.split():
+                k, v = kv.split("=", 1)
+                obj[k] = int(v, 0)
+            out.append(obj)
+        return out
+
+    objs = parse_objects(rest)
+    if op == "create_accounts":
+        recs = types.batch([types.account(**o) for o in objs], types.ACCOUNT_DTYPE)
+        res = client.create_accounts(recs)
+        print("ok" if len(res) == 0 else res)
+    elif op == "create_transfers":
+        recs = types.batch([types.transfer(**o) for o in objs], types.TRANSFER_DTYPE)
+        res = client.create_transfers(recs)
+        print("ok" if len(res) == 0 else res)
+    elif op == "lookup_accounts":
+        recs = client.lookup_accounts([o["id"] for o in objs])
+        for r in recs:
+            print({
+                "id": types.u128_of(r, "id"),
+                "debits_posted": types.u128_of(r, "debits_posted"),
+                "credits_posted": types.u128_of(r, "credits_posted"),
+                "debits_pending": types.u128_of(r, "debits_pending"),
+                "credits_pending": types.u128_of(r, "credits_pending"),
+                "ledger": int(r["ledger"]), "code": int(r["code"]),
+            })
+    elif op == "lookup_transfers":
+        recs = client.lookup_transfers([o["id"] for o in objs])
+        for r in recs:
+            print({
+                "id": types.u128_of(r, "id"),
+                "amount": types.u128_of(r, "amount"),
+                "timestamp": int(r["timestamp"]),
+            })
+    elif op == "get_account_transfers":
+        recs = client.get_account_transfers(objs[0]["account_id"])
+        print(f"{len(recs)} transfers")
+        for r in recs[:10]:
+            print({"id": types.u128_of(r, "id"), "amount": types.u128_of(r, "amount")})
+    else:
+        print(f"unknown operation: {op}")
+
+
+def cmd_benchmark(args) -> int:
+    """Spawn a temp single-replica cluster and run the load (reference
+    benchmark_driver.zig + benchmark_load.zig). For the pure device-kernel
+    number see bench.py at the repo root."""
+    import os
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    from tigerbeetle_tpu import types
+    from tigerbeetle_tpu.client import Client
+
+    port = args.port
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "bench.tigerbeetle")
+        rc = cmd_format(argparse.Namespace(
+            path=path, cluster=0, replica=0, replica_count=1, config=args.config
+        ))
+        assert rc == 0
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "tigerbeetle_tpu.cli", "start",
+                f"--addresses=127.0.0.1:{port}", "--replica=0",
+                f"--config={args.config}", f"--backend={args.backend}", path,
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        )
+        try:
+            proc.stdout.readline()  # wait for "listening"
+            client = Client([("127.0.0.1", port)])
+            batch = min(args.batch, 8190)
+
+            ids = np.arange(1, args.accounts + 1, dtype=np.uint64)
+            for s in range(0, args.accounts, batch):
+                chunk = ids[s : s + batch]
+                ev = np.zeros(len(chunk), dtype=types.ACCOUNT_DTYPE)
+                ev["id_lo"] = chunk
+                ev["ledger"] = 1
+                ev["code"] = 10
+                res = client.create_accounts(ev)
+                assert len(res) == 0
+
+            rng = np.random.default_rng(0xBEE)
+            sent = 0
+            lat = []
+            t0 = time.perf_counter()
+            next_id = 1
+            while sent < args.transfers:
+                n = min(batch, args.transfers - sent)
+                ev = np.zeros(n, dtype=types.TRANSFER_DTYPE)
+                ev["id_lo"] = np.arange(next_id, next_id + n, dtype=np.uint64)
+                next_id += n
+                dr = rng.integers(1, args.accounts + 1, n).astype(np.uint64)
+                cr = rng.integers(1, args.accounts + 1, n).astype(np.uint64)
+                cr = np.where(cr == dr, (cr % args.accounts) + 1, cr)
+                ev["debit_account_id_lo"] = dr
+                ev["credit_account_id_lo"] = cr
+                ev["amount_lo"] = rng.integers(1, 1000, n)
+                ev["ledger"] = 1
+                ev["code"] = 7
+                b0 = time.perf_counter()
+                client.create_transfers(ev)
+                lat.append(time.perf_counter() - b0)
+                sent += n
+            dt = time.perf_counter() - t0
+            lat.sort()
+            print(f"load accepted = {sent / dt:,.0f} tx/s")
+            print(f"batch latency p50 = {lat[len(lat) // 2] * 1e3:.2f} ms")
+            print(f"batch latency p90 = {lat[int(len(lat) * 0.9)] * 1e3:.2f} ms")
+        finally:
+            proc.terminate()
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tigerbeetle-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    f = sub.add_parser("format", help="create a data file")
+    f.add_argument("path")
+    f.add_argument("--cluster", type=int, default=0)
+    f.add_argument("--replica", type=int, required=True)
+    f.add_argument("--replica-count", type=int, default=1)
+    f.add_argument("--config", default="production")
+    f.set_defaults(fn=cmd_format)
+
+    s = sub.add_parser("start", help="start a replica")
+    s.add_argument("path")
+    s.add_argument("--addresses", required=True)
+    s.add_argument("--replica", type=int, required=True)
+    s.add_argument("--cluster", type=int, default=0)
+    s.add_argument("--config", default="production")
+    s.add_argument("--backend", default="jax", choices=["jax", "numpy"])
+    s.set_defaults(fn=cmd_start)
+
+    v = sub.add_parser("version")
+    v.set_defaults(fn=lambda a: (print(f"tigerbeetle-tpu {VERSION}"), 0)[1])
+
+    r = sub.add_parser("repl", help="interactive client")
+    r.add_argument("--addresses", required=True)
+    r.add_argument("--cluster", type=int, default=0)
+    r.set_defaults(fn=cmd_repl)
+
+    b = sub.add_parser("benchmark", help="spawn temp cluster + run load")
+    b.add_argument("--accounts", type=int, default=10_000)
+    b.add_argument("--transfers", type=int, default=100_000)
+    b.add_argument("--batch", type=int, default=8190)
+    b.add_argument("--port", type=int, default=3001)
+    b.add_argument("--config", default="production")
+    b.add_argument("--backend", default="jax", choices=["jax", "numpy"])
+    b.set_defaults(fn=cmd_benchmark)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,193 @@
+"""Synchronous Python client library.
+
+The user-facing API (the role of /root/reference/src/clients/* and
+src/vsr/client.zig:20): session registration, one request in flight,
+automatic primary discovery and resend, typed batch submission. Blocking
+socket implementation — suitable for scripts, the REPL, and the benchmark;
+an async variant can wrap the same framing.
+"""
+
+from __future__ import annotations
+
+import secrets
+import socket
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.vsr import header as hdr
+from tigerbeetle_tpu.vsr.header import HEADER_SIZE, Command, Header, Message, Operation
+
+
+class ClientError(Exception):
+    pass
+
+
+class SessionEvicted(ClientError):
+    pass
+
+
+class Client:
+    REQUEST_TIMEOUT = 2.0  # seconds before retrying on the next replica
+
+    def __init__(
+        self,
+        addresses: Sequence[Tuple[str, int]],
+        cluster: int = 0,
+        client_id: Optional[int] = None,
+    ) -> None:
+        self.addresses = list(addresses)
+        self.cluster = cluster
+        self.id = client_id if client_id is not None else secrets.randbits(127) | 1
+        self.request_number = 0
+        self._sock: Optional[socket.socket] = None
+        self._target = 0
+        self._buf = b""
+        self.register()
+
+    # --- wire -----------------------------------------------------------
+
+    def _connect(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        for _ in range(len(self.addresses)):
+            host, port = self.addresses[self._target % len(self.addresses)]
+            try:
+                self._sock = socket.create_connection((host, port), timeout=self.REQUEST_TIMEOUT)
+                self._sock.settimeout(self.REQUEST_TIMEOUT)
+                self._buf = b""
+                return
+            except OSError:
+                self._target += 1
+        raise ClientError(f"no replica reachable at {self.addresses}")
+
+    def _recv_message(self) -> Optional[Message]:
+        assert self._sock is not None
+        while True:
+            if len(self._buf) >= HEADER_SIZE:
+                h = Header.from_bytes(self._buf[:HEADER_SIZE])
+                size = h["size"]
+                if len(self._buf) >= size:
+                    raw = self._buf[:size]
+                    self._buf = self._buf[size:]
+                    msg = Message.from_bytes(raw)
+                    if msg.verify():
+                        return msg
+                    continue
+            try:
+                chunk = self._sock.recv(1 << 16)
+            except socket.timeout:
+                return None
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            self._buf += chunk
+
+    def _roundtrip(self, operation: int, body: bytes) -> Message:
+        self.request_number += 1
+        req = hdr.make(
+            Command.REQUEST, self.cluster,
+            client=self.id, request=self.request_number, operation=operation,
+        )
+        msg = Message(req, body).seal()
+        deadline_attempts = 4 * len(self.addresses) + 4
+        for _ in range(deadline_attempts):
+            if self._sock is None:
+                self._connect()
+            try:
+                self._sock.sendall(msg.to_bytes())
+            except OSError:
+                self._target += 1
+                self._sock = None
+                continue
+            start = time.monotonic()
+            while time.monotonic() - start < self.REQUEST_TIMEOUT:
+                reply = self._recv_message()
+                if reply is None:
+                    break
+                h = reply.header
+                if h["command"] == Command.EVICTION:
+                    raise SessionEvicted("session evicted by cluster")
+                if (
+                    h["command"] == Command.REPLY
+                    and h["client"] == self.id
+                    and h["request"] == self.request_number
+                ):
+                    return reply
+            self._target += 1
+            self._sock = None
+        raise ClientError("request timed out against every replica")
+
+    # --- session --------------------------------------------------------
+
+    def register(self) -> None:
+        self._roundtrip(Operation.REGISTER, b"")
+
+    def close(self) -> None:
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+
+    # --- typed operations ----------------------------------------------
+
+    def create_accounts(self, accounts: np.ndarray) -> np.ndarray:
+        reply = self._roundtrip(Operation.CREATE_ACCOUNTS, accounts.tobytes())
+        return np.frombuffer(bytearray(reply.body), dtype=types.EVENT_RESULT_DTYPE)
+
+    def create_transfers(self, transfers: np.ndarray) -> np.ndarray:
+        reply = self._roundtrip(Operation.CREATE_TRANSFERS, transfers.tobytes())
+        return np.frombuffer(bytearray(reply.body), dtype=types.EVENT_RESULT_DTYPE)
+
+    def _ids_body(self, ids: Sequence[int]) -> bytes:
+        arr = np.zeros(len(ids), dtype=types.ID_DTYPE)
+        for i, v in enumerate(ids):
+            arr[i]["lo"] = v & types.U64_MAX
+            arr[i]["hi"] = v >> 64
+        return arr.tobytes()
+
+    def lookup_accounts(self, ids: Sequence[int]) -> np.ndarray:
+        reply = self._roundtrip(Operation.LOOKUP_ACCOUNTS, self._ids_body(ids))
+        return np.frombuffer(bytearray(reply.body), dtype=types.ACCOUNT_DTYPE)
+
+    def lookup_transfers(self, ids: Sequence[int]) -> np.ndarray:
+        reply = self._roundtrip(Operation.LOOKUP_TRANSFERS, self._ids_body(ids))
+        return np.frombuffer(bytearray(reply.body), dtype=types.TRANSFER_DTYPE)
+
+    def _filter_body(
+        self, account_id: int, timestamp_min: int, timestamp_max: int,
+        limit: int, flags: int,
+    ) -> bytes:
+        f = np.zeros(1, dtype=types.ACCOUNT_FILTER_DTYPE)
+        f[0]["account_id_lo"] = account_id & types.U64_MAX
+        f[0]["account_id_hi"] = account_id >> 64
+        f[0]["timestamp_min"] = timestamp_min
+        f[0]["timestamp_max"] = timestamp_max
+        f[0]["limit"] = limit
+        f[0]["flags"] = flags
+        return f.tobytes()
+
+    def get_account_transfers(
+        self, account_id: int, timestamp_min: int = 0, timestamp_max: int = 0,
+        limit: int = 8190, flags: int = 0x3,
+    ) -> np.ndarray:
+        reply = self._roundtrip(
+            Operation.GET_ACCOUNT_TRANSFERS,
+            self._filter_body(account_id, timestamp_min, timestamp_max, limit, flags),
+        )
+        return np.frombuffer(bytearray(reply.body), dtype=types.TRANSFER_DTYPE)
+
+    def get_account_history(
+        self, account_id: int, timestamp_min: int = 0, timestamp_max: int = 0,
+        limit: int = 8190, flags: int = 0x3,
+    ) -> np.ndarray:
+        reply = self._roundtrip(
+            Operation.GET_ACCOUNT_HISTORY,
+            self._filter_body(account_id, timestamp_min, timestamp_max, limit, flags),
+        )
+        return np.frombuffer(bytearray(reply.body), dtype=types.ACCOUNT_BALANCE_DTYPE)

@@ -66,6 +66,9 @@ class Config:
     checkpoint_interval: int = VSR_CHECKPOINT_INTERVAL
     # Device memtable runs before a merge is forced (LSM-on-device shape).
     state_runs_max: int = 4
+    # Wire/disk: max message = header + batch_max records (reference
+    # message_header.zig:70; smaller in test presets so WAL files stay tiny).
+    message_size_max: int = MESSAGE_SIZE_MAX
 
 
 PRODUCTION = Config()
@@ -80,6 +83,7 @@ TEST_MIN = Config(
     clients_max=4,
     checkpoint_interval=16,
     state_runs_max=2,
+    message_size_max=HEADER_SIZE + 64 * 128,
 )
 
 

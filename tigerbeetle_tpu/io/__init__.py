@@ -1,0 +1,3 @@
+"""Host IO: block storage backends (file-backed and simulated)."""
+
+from tigerbeetle_tpu.io.storage import FileStorage, MemStorage, Zone  # noqa: F401

@@ -1,0 +1,155 @@
+"""Block storage: sector-addressed durable byte ranges.
+
+The production backend is a file (buffered writes + fsync on `sync()`; the
+reference's O_DIRECT discipline, src/storage.zig:14, is a later native-shim
+concern). The test backend is in-memory with per-sector fault injection,
+mirroring src/testing/storage.zig:57 — reads of faulty sectors return
+corrupted bytes so recovery paths are exercised, and `crash()` drops writes
+that were not yet synced (torn-write model).
+
+The on-disk layout zones mirror src/vsr.zig:67-109.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from tigerbeetle_tpu.constants import SECTOR_SIZE
+from tigerbeetle_tpu.vsr.header import HEADER_SIZE
+
+
+@dataclass(frozen=True)
+class Zone:
+    """Fixed on-disk layout (offsets derived from a Config at format time)."""
+
+    superblock_offset: int
+    superblock_size: int
+    wal_headers_offset: int
+    wal_headers_size: int
+    wal_prepares_offset: int
+    wal_prepares_size: int
+    client_replies_offset: int
+    client_replies_size: int
+
+    @property
+    def total_size(self) -> int:
+        return self.client_replies_offset + self.client_replies_size
+
+    @staticmethod
+    def for_config(
+        journal_slot_count: int,
+        message_size_max: int,
+        clients_max: int,
+        superblock_copies: int = 4,
+        superblock_copy_size: int = SECTOR_SIZE,
+    ) -> "Zone":
+        sb_size = superblock_copies * superblock_copy_size
+        wh_size = journal_slot_count * HEADER_SIZE
+        wh_size = -(-wh_size // SECTOR_SIZE) * SECTOR_SIZE
+        wp_size = journal_slot_count * message_size_max
+        cr_size = clients_max * message_size_max
+        sb_off = 0
+        wh_off = sb_off + sb_size
+        wp_off = wh_off + wh_size
+        cr_off = wp_off + wp_size
+        return Zone(
+            superblock_offset=sb_off, superblock_size=sb_size,
+            wal_headers_offset=wh_off, wal_headers_size=wh_size,
+            wal_prepares_offset=wp_off, wal_prepares_size=wp_size,
+            client_replies_offset=cr_off, client_replies_size=cr_size,
+        )
+
+
+class MemStorage:
+    """In-memory storage with fault injection and a crash model."""
+
+    def __init__(self, size: int, seed: int = 0) -> None:
+        self.size = size
+        self._data = bytearray(size)
+        # Writes since the last sync: {offset: bytes} — dropped on crash()
+        # with probability per write (torn-write model).
+        self._unsynced: dict[int, bytes] = {}
+        self._faulty_sectors: set[int] = set()
+        import random
+
+        self._rng = random.Random(seed)
+        self.reads = 0
+        self.writes = 0
+
+    def read(self, offset: int, size: int) -> bytes:
+        self.reads += 1
+        out = bytearray(self._data[offset : offset + size])
+        # Overlay unsynced writes (the OS page cache view).
+        for woff, wdata in self._unsynced.items():
+            lo = max(offset, woff)
+            hi = min(offset + size, woff + len(wdata))
+            if lo < hi:
+                out[lo - offset : hi - offset] = wdata[lo - woff : hi - woff]
+        # Corrupt faulty sectors.
+        first = offset // SECTOR_SIZE
+        last = (offset + size - 1) // SECTOR_SIZE
+        for s in range(first, last + 1):
+            if s in self._faulty_sectors:
+                lo = max(offset, s * SECTOR_SIZE)
+                hi = min(offset + size, (s + 1) * SECTOR_SIZE)
+                out[lo - offset : hi - offset] = bytes(
+                    (b ^ 0xA5) for b in out[lo - offset : hi - offset]
+                )
+        return bytes(out)
+
+    def write(self, offset: int, data: bytes) -> None:
+        assert offset + len(data) <= self.size
+        self.writes += 1
+        self._unsynced[offset] = bytes(data)
+
+    def sync(self) -> None:
+        for woff, wdata in self._unsynced.items():
+            self._data[woff : woff + len(wdata)] = wdata
+        self._unsynced = {}
+
+    # --- fault injection ------------------------------------------------
+
+    def crash(self, torn_write_probability: float = 0.5) -> None:
+        """Lose or tear unsynced writes, then clear them (process crash)."""
+        for woff, wdata in self._unsynced.items():
+            r = self._rng.random()
+            if r < torn_write_probability:
+                continue  # write lost entirely
+            # write applied, possibly torn at a sector boundary
+            keep = len(wdata)
+            if self._rng.random() < 0.5 and len(wdata) > SECTOR_SIZE:
+                sectors = len(wdata) // SECTOR_SIZE
+                keep = self._rng.randrange(1, sectors + 1) * SECTOR_SIZE
+            self._data[woff : woff + keep] = wdata[:keep]
+        self._unsynced = {}
+
+    def corrupt_sector(self, sector: int) -> None:
+        self._faulty_sectors.add(sector)
+
+    def repair_sector(self, sector: int) -> None:
+        self._faulty_sectors.discard(sector)
+
+
+class FileStorage:
+    """File-backed storage (buffered + fsync)."""
+
+    def __init__(self, path: str, size: int | None = None, create: bool = False) -> None:
+        self.path = path
+        flags = os.O_RDWR | (os.O_CREAT if create else 0)
+        self._fd = os.open(path, flags, 0o644)
+        if create and size is not None:
+            os.ftruncate(self._fd, size)
+        self.size = os.fstat(self._fd).st_size
+
+    def read(self, offset: int, size: int) -> bytes:
+        return os.pread(self._fd, size, offset)
+
+    def write(self, offset: int, data: bytes) -> None:
+        os.pwrite(self._fd, data, offset)
+
+    def sync(self) -> None:
+        os.fsync(self._fd)
+
+    def close(self) -> None:
+        os.close(self._fd)

@@ -1,0 +1,3 @@
+"""Production network transport: framed, checksummed messages over TCP."""
+
+from tigerbeetle_tpu.net.bus import ReplicaServer  # noqa: F401

@@ -1,0 +1,160 @@
+"""Asyncio TCP message bus for replicas.
+
+Mirrors /root/reference/src/message_bus.zig:24 semantics: each replica
+listens on its address and *connects out* to lower-indexed peers (one
+connection per replica pair); clients connect to any replica. Messages are
+framed as 256-byte checksummed header + body (header.size total), validated
+before dispatch; invalid frames drop the connection. Reconnects use
+exponential backoff. The reference runs on io_uring; the host side of this
+build uses asyncio (a native io_uring shim is a later-round optimization —
+the TPU data path does not cross this layer).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional, Tuple
+
+from tigerbeetle_tpu.vsr.header import HEADER_SIZE, Command, Header, Message
+
+
+class _Conn:
+    def __init__(self, writer: asyncio.StreamWriter) -> None:
+        self.writer = writer
+
+    def send(self, data: bytes) -> None:
+        if not self.writer.is_closing():
+            self.writer.write(data)
+
+
+async def read_message(reader: asyncio.StreamReader) -> Optional[Message]:
+    try:
+        hraw = await reader.readexactly(HEADER_SIZE)
+    except (asyncio.IncompleteReadError, ConnectionError):
+        return None
+    h = Header.from_bytes(hraw)
+    if not h.valid_checksum():
+        return None
+    size = h["size"]
+    if size < HEADER_SIZE or size > (1 << 21):
+        return None
+    body = b""
+    if size > HEADER_SIZE:
+        try:
+            body = await reader.readexactly(size - HEADER_SIZE)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            return None
+    msg = Message(h, body)
+    if not h.valid_checksum_body(body):
+        return None
+    return msg
+
+
+class ReplicaServer:
+    """Hosts one replica: TCP listener + peer connections + tick loop."""
+
+    TICK_SECONDS = 0.01
+
+    def __init__(self, replica, addresses: List[Tuple[str, int]]) -> None:
+        self.replica = replica
+        self.addresses = addresses
+        self.me = replica.replica
+        self.peer_conns: Dict[int, _Conn] = {}
+        self.client_conns: Dict[int, _Conn] = {}
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._stopping = asyncio.Event()
+        replica.bus = self  # inject ourselves as the bus
+
+    # --- bus interface (called from replica logic) ----------------------
+
+    def send_to_replica(self, r: int, msg: Message) -> None:
+        if r == self.me:
+            self.replica.on_message(msg.copy())
+            return
+        conn = self.peer_conns.get(r)
+        if conn is not None:
+            conn.send(msg.to_bytes())
+
+    def send_to_client(self, client_id: int, msg: Message) -> None:
+        conn = self.client_conns.get(client_id)
+        if conn is not None:
+            conn.send(msg.to_bytes())
+
+    # --- lifecycle ------------------------------------------------------
+
+    async def start(self) -> None:
+        host, port = self.addresses[self.me]
+        self._server = await asyncio.start_server(self._on_accept, host, port)
+        for r in range(len(self.addresses)):
+            if r < self.me:
+                asyncio.ensure_future(self._connect_peer(r))
+        asyncio.ensure_future(self._tick_loop())
+
+    async def serve_forever(self) -> None:
+        await self.start()
+        await self._stopping.wait()
+
+    def stop(self) -> None:
+        self._stopping.set()
+        if self._server is not None:
+            self._server.close()
+
+    async def _tick_loop(self) -> None:
+        while not self._stopping.is_set():
+            self.replica.tick()
+            await asyncio.sleep(self.TICK_SECONDS)
+
+    # --- connections ----------------------------------------------------
+
+    async def _connect_peer(self, r: int) -> None:
+        backoff = 0.05
+        host, port = self.addresses[r]
+        while not self._stopping.is_set():
+            try:
+                reader, writer = await asyncio.open_connection(host, port)
+            except OSError:
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 2.0)
+                continue
+            backoff = 0.05
+            self.peer_conns[r] = _Conn(writer)
+            # Identify ourselves so the acceptor can map the connection.
+            hello = Message(
+                Header(None, command=Command.PING, replica=self.me,
+                       cluster=self.replica.cluster)
+            ).seal()
+            writer.write(hello.to_bytes())
+            await self._read_loop(reader, expected_replica=r)
+            self.peer_conns.pop(r, None)
+
+    async def _on_accept(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        conn = _Conn(writer)
+        peer_replica: Optional[int] = None
+        client_id: Optional[int] = None
+        while not self._stopping.is_set():
+            msg = await read_message(reader)
+            if msg is None:
+                break
+            h = msg.header
+            if h["command"] == Command.REQUEST or h["command"] == Command.PING_CLIENT:
+                if client_id is None and h["client"] != 0:
+                    client_id = h["client"]
+                    self.client_conns[client_id] = conn
+            elif peer_replica is None and h["replica"] != self.me:
+                peer_replica = h["replica"]
+                self.peer_conns.setdefault(peer_replica, conn)
+            self.replica.on_message(msg)
+        if client_id is not None and self.client_conns.get(client_id) is conn:
+            del self.client_conns[client_id]
+        if peer_replica is not None and self.peer_conns.get(peer_replica) is conn:
+            del self.peer_conns[peer_replica]
+        writer.close()
+
+    async def _read_loop(self, reader: asyncio.StreamReader, expected_replica: int) -> None:
+        while not self._stopping.is_set():
+            msg = await read_message(reader)
+            if msg is None:
+                return
+            self.replica.on_message(msg)

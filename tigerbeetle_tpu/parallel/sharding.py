@@ -49,8 +49,6 @@ def make_mesh(n_devices: int | None = None, dp: int | None = None) -> Mesh:
         dp = 1
         while dp * 2 * dp * 2 <= n and n % (dp * 2) == 0:
             dp *= 2
-        if n % dp != 0:
-            dp = 1
     shard = n // dp
     assert dp * shard == n
     dev = np.array(devices[:n]).reshape(dp, shard)
@@ -83,16 +81,18 @@ def batch_specs() -> TransferBatch:
     )
 
 
+def _place(state: LedgerState, mesh: Mesh) -> LedgerState:
+    return LedgerState(*[
+        jax.device_put(arr, NamedSharding(mesh, spec))
+        for arr, spec in zip(state, state_specs())
+    ])
+
+
 def init_sharded_state(accounts_max: int, mesh: Mesh) -> LedgerState:
     """Zero-initialized ledger state placed with the sharding above."""
     n_shard = mesh.shape["shard"]
     assert accounts_max % n_shard == 0, "accounts_max must divide the shard axis"
-    host = commit_ops.init_state(accounts_max)
-    specs = state_specs()
-    return LedgerState(*[
-        jax.device_put(arr, NamedSharding(mesh, spec))
-        for arr, spec in zip(host, specs)
-    ])
+    return _place(commit_ops.init_state(accounts_max), mesh)
 
 
 def make_sharded_commit(mesh: Mesh, accounts_max: int):
@@ -102,9 +102,15 @@ def make_sharded_commit(mesh: Mesh, accounts_max: int):
     over `shard` and the batch over `dp`.
     """
     n_shard = mesh.shape["shard"]
-    rows_per_shard = accounts_max // n_shard
+    assert accounts_max % n_shard == 0, "accounts_max must divide the shard axis"
 
     def step(state: LedgerState, b: TransferBatch, host_code: jnp.ndarray):
+        # Derive the shard size from the actual local shape — a mismatched
+        # accounts_max would otherwise silently drop postings.
+        rows_per_shard = state.debits_pending.shape[0]
+        assert rows_per_shard == accounts_max // n_shard, (
+            "state shape does not match accounts_max"
+        )
         # --- dp-sharded validation (state metadata is replicated) ---------
         code, unsupported = commit_ops.validate_simple(state, b)
         code = commit_ops.merge_codes(code, host_code)
@@ -165,9 +171,4 @@ def register_accounts_sharded(
     Balances stay zero; only the replicated arrays change, so a plain jitted
     update with preserved shardings suffices.
     """
-    new = commit_ops.register_accounts(state, slots, ledger, flags, mask)
-    specs = state_specs()
-    return LedgerState(*[
-        jax.device_put(arr, NamedSharding(mesh, spec))
-        for arr, spec in zip(new, specs)
-    ])
+    return _place(commit_ops.register_accounts(state, slots, ledger, flags, mask), mesh)

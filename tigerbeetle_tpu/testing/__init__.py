@@ -1,0 +1,9 @@
+"""Deterministic test infrastructure: simulated cluster, network, checkers.
+
+The reference's keystone (SURVEY.md §4): total determinism — a seed
+reproduces an entire cluster execution bit-for-bit. N replicas + clients run
+in one process over a seeded packet simulator (loss/delay/partitions) and
+fault-injecting in-memory storage; checkers assert cross-replica agreement.
+"""
+
+from tigerbeetle_tpu.testing.cluster import Cluster, SimClient  # noqa: F401

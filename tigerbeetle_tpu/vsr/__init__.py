@@ -1,0 +1,11 @@
+"""Viewstamped Replication: consensus, journal, durability, client.
+
+The replica logic is a deterministic event-driven core
+(/root/reference/src/vsr/replica.zig re-designed host-side in Python — the
+TPU owns the state-machine math, the host owns ordering and durability).
+IO is injected (the reference's comptime DI, SURVEY.md §4): the same
+Replica runs over asyncio TCP + files in production and over the seeded
+in-process simulator in tests.
+"""
+
+from tigerbeetle_tpu.vsr.header import Command, Header  # noqa: F401

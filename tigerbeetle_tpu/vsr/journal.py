@@ -1,0 +1,123 @@
+"""WAL journal: two on-disk rings (redundant headers + prepares).
+
+Mirrors /root/reference/src/vsr/journal.zig:18-67 — slot = op % slot_count;
+the headers ring holds each slot's 256-byte prepare header redundantly so
+recovery can distinguish a torn prepare body from a missing one; the
+prepares ring holds full messages. Recovery classifies each slot by
+cross-checking both rings (journal.zig recovery cases, simplified to the
+decision table that matters for a ring that is never reused before
+checkpoint: valid / torn / missing).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from tigerbeetle_tpu.io.storage import Zone
+from tigerbeetle_tpu.vsr.header import HEADER_SIZE, Command, Header, Message
+
+
+class Journal:
+    def __init__(self, storage, zone: Zone, slot_count: int, message_size_max: int) -> None:
+        self.storage = storage
+        self.zone = zone
+        self.slot_count = slot_count
+        self.message_size_max = message_size_max
+        # op currently durable in each slot (in-memory mirror of the ring).
+        self.headers: Dict[int, Header] = {}  # slot -> prepare header
+        self.dirty: set[int] = set()
+        self.faulty: set[int] = set()
+
+    def slot_for_op(self, op: int) -> int:
+        return op % self.slot_count
+
+    # --- write ----------------------------------------------------------
+
+    def write_prepare(self, message: Message, sync: bool = True) -> None:
+        """Durably store a prepare in its slot (body ring then header ring;
+        reference replica.zig:8454 writes sectors of both rings)."""
+        assert message.header["command"] == Command.PREPARE
+        op = message.header["op"]
+        slot = self.slot_for_op(op)
+        raw = message.to_bytes()
+        assert len(raw) <= self.message_size_max
+        self.storage.write(
+            self.zone.wal_prepares_offset + slot * self.message_size_max, raw
+        )
+        self.storage.write(
+            self.zone.wal_headers_offset + slot * HEADER_SIZE, message.header.to_bytes()
+        )
+        if sync:
+            self.storage.sync()
+        self.headers[slot] = message.header.copy()
+        self.dirty.discard(slot)
+        self.faulty.discard(slot)
+
+    # --- read -----------------------------------------------------------
+
+    def read_prepare(self, op: int) -> Optional[Message]:
+        slot = self.slot_for_op(op)
+        h = self.headers.get(slot)
+        if h is None or h["op"] != op:
+            return None
+        raw = self.storage.read(
+            self.zone.wal_prepares_offset + slot * self.message_size_max,
+            self.message_size_max,
+        )
+        msg = Message.from_bytes(raw)
+        if not msg.verify() or msg.header["op"] != op:
+            return None
+        return msg
+
+    # --- recovery -------------------------------------------------------
+
+    def recover(self, cluster: int) -> List[Header]:
+        """Scan both rings; returns valid prepare headers (by slot).
+
+        Classification per slot (journal.zig recovery, reduced):
+          - header ring valid + prepares ring matches  → ok
+          - header ring valid + body torn/corrupt      → faulty (needs repair)
+          - neither valid                              → missing (fresh slot)
+        """
+        self.headers = {}
+        self.dirty = set()
+        self.faulty = set()
+        out: List[Header] = []
+        for slot in range(self.slot_count):
+            hraw = self.storage.read(
+                self.zone.wal_headers_offset + slot * HEADER_SIZE, HEADER_SIZE
+            )
+            rh = Header.from_bytes(hraw)
+            header_ok = (
+                rh.valid_checksum()
+                and rh["command"] == Command.PREPARE
+                and rh["cluster"] == cluster
+            )
+            praw = self.storage.read(
+                self.zone.wal_prepares_offset + slot * self.message_size_max,
+                self.message_size_max,
+            )
+            ph = Header.from_bytes(praw[:HEADER_SIZE])
+            prepare_ok = (
+                ph.valid_checksum()
+                and ph["command"] == Command.PREPARE
+                and ph["cluster"] == cluster
+                and ph.valid_checksum_body(praw[HEADER_SIZE : ph["size"]])
+            )
+            if header_ok and prepare_ok and rh["checksum"] == ph["checksum"]:
+                self.headers[slot] = rh
+                out.append(rh)
+            elif header_ok and not prepare_ok:
+                # Redundant header says a prepare should be here: torn body.
+                self.headers[slot] = rh
+                self.faulty.add(slot)
+            elif prepare_ok:
+                # Body intact but header ring torn — body is authoritative.
+                self.headers[slot] = ph
+                out.append(ph)
+                self.dirty.add(slot)  # header ring needs rewrite
+        return out
+
+    def highest_op(self) -> int:
+        ops = [h["op"] for s, h in self.headers.items() if s not in self.faulty]
+        return max(ops) if ops else 0

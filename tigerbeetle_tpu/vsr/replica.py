@@ -1,0 +1,879 @@
+"""The VSR replica: consensus, commit pipeline, view change, WAL repair.
+
+Re-designs /root/reference/src/vsr/replica.zig (9.4k LoC of Zig) as a
+deterministic event-driven Python core with injected IO: `bus` delivers and
+sends messages, `time` supplies ticks, `storage` backs the journal and
+superblock, and the TPU-accelerated StateMachine executes committed ops.
+The protocol implemented this round:
+
+  normal:      on_request (:1309) → primary_pipeline_prepare (:5130) →
+               on_prepare (:1365) → journal write → prepare_ok (:1470) →
+               quorum → commit_op (:3679) → reply; backups commit via the
+               piggybacked commit number and the commit heartbeat (:1592).
+  view change: SVC/DVC/start_view (:1703-1902) with longest-log selection.
+  repair:      request_prepare / on_request_prepare (:2049) for WAL gaps.
+  checkpoint:  state-machine snapshot + superblock advance every
+               checkpoint_interval ops (simplified grid: whole-state
+               snapshot, incremental blocks are a later round).
+
+Determinism: every state transition is a pure function of (durable state,
+delivered messages, tick counter) — the cluster simulator replays a seed to
+an identical execution, byte-for-byte (SURVEY.md §4 keystone).
+"""
+
+from __future__ import annotations
+
+import io as _io
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.constants import Config
+from tigerbeetle_tpu.io.storage import Zone
+from tigerbeetle_tpu.models.state_machine import StateMachine
+from tigerbeetle_tpu.vsr import header as hdr
+from tigerbeetle_tpu.vsr.header import Command, Header, Message, Operation
+from tigerbeetle_tpu.vsr.journal import Journal
+from tigerbeetle_tpu.vsr.superblock import SuperBlock, VSRState
+
+STATUS_NORMAL = "normal"
+STATUS_VIEW_CHANGE = "view_change"
+STATUS_RECOVERING = "recovering"
+
+# Tick counts (the reference's timeouts, replica.zig:2535-2861, scaled to
+# abstract ticks; the production loop maps ticks to ~10ms).
+PING_TIMEOUT = 50
+PREPARE_TIMEOUT = 30
+COMMIT_HEARTBEAT_TIMEOUT = 40
+NORMAL_HEARTBEAT_TIMEOUT = 200
+VIEW_CHANGE_TIMEOUT = 300
+REPAIR_TIMEOUT = 20
+
+
+def _event_dtype(operation: int) -> np.dtype:
+    if operation == Operation.CREATE_ACCOUNTS:
+        return types.ACCOUNT_DTYPE
+    if operation == Operation.CREATE_TRANSFERS:
+        return types.TRANSFER_DTYPE
+    if operation in (Operation.LOOKUP_ACCOUNTS, Operation.LOOKUP_TRANSFERS):
+        return types.ID_DTYPE
+    return types.ACCOUNT_FILTER_DTYPE
+
+
+class ClientSession:
+    __slots__ = ("session", "request", "reply")
+
+    def __init__(self, session: int) -> None:
+        self.session = session
+        self.request = 0
+        self.reply: Optional[Message] = None
+
+
+class Pipeline:
+    """Primary-side prepare pipeline (reference replica.zig:100-115)."""
+
+    __slots__ = ("message", "ok_from")
+
+    def __init__(self, message: Message) -> None:
+        self.message = message
+        self.ok_from: set[int] = set()
+
+
+class Replica:
+    def __init__(
+        self,
+        *,
+        cluster: int,
+        replica_index: int,
+        replica_count: int,
+        storage,
+        zone: Zone,
+        config: Config,
+        bus,
+        snapshot_store=None,
+        sm_backend: str = "numpy",
+        on_event: Optional[Callable[[str, "Replica"], None]] = None,
+    ) -> None:
+        self.cluster = cluster
+        self.replica = replica_index
+        self.replica_count = replica_count
+        self.config = config
+        self.storage = storage
+        self.zone = zone
+        self.bus = bus
+        self.snapshot_store = snapshot_store
+        self.sm_backend = sm_backend
+        self.on_event = on_event or (lambda kind, r: None)
+
+        self.superblock = SuperBlock(storage, zone)
+        self.journal = Journal(
+            storage, zone, config.journal_slot_count, config.message_size_max
+        )
+        self.state_machine = StateMachine(config, backend=sm_backend)
+
+        self.status = STATUS_RECOVERING
+        self.view = 0
+        self.log_view = 0
+        self.op = 0  # highest op in journal
+        self.commit_min = 0  # highest committed AND executed
+        self.commit_max = 0  # highest committable known
+        self.pipeline: List[Pipeline] = []
+        self.request_queue: List[Message] = []
+        self.clients: Dict[int, ClientSession] = {}
+
+        self.start_view_change_from: Dict[int, set[int]] = {}  # view -> replicas
+        self.do_view_change_from: Dict[int, Dict[int, Message]] = {}
+        self._dvc_sent_for_view = -1
+
+        self.tick_count = 0
+        self.last_heartbeat_tick = 0
+        self.last_commit_sent_tick = 0
+        self.last_repair_tick = 0
+
+        # commit-number → checksum chain, used by the state checker.
+        self.commit_checksums: Dict[int, int] = {}
+
+    # ------------------------------------------------------------------
+
+    @property
+    def quorum_replication(self) -> int:
+        # reference vsr.zig:910 flexible quorums
+        return {1: 1, 2: 2, 3: 2, 4: 2, 5: 3, 6: 3}[self.replica_count]
+
+    @property
+    def quorum_view_change(self) -> int:
+        return {1: 1, 2: 2, 3: 2, 4: 3, 5: 3, 6: 4}[self.replica_count]
+
+    def primary_index(self, view: int) -> int:
+        return view % self.replica_count
+
+    @property
+    def is_primary(self) -> bool:
+        return self.status == STATUS_NORMAL and self.primary_index(self.view) == self.replica
+
+    @property
+    def is_backup(self) -> bool:
+        return self.status == STATUS_NORMAL and not self.is_primary
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    @staticmethod
+    def format(storage, zone: Zone, cluster: int, replica_index: int, replica_count: int) -> None:
+        """Write a fresh data file (reference vsr/replica_format.zig)."""
+        sb = SuperBlock(storage, zone)
+        sb.format(
+            VSRState(cluster=cluster, replica=replica_index, replica_count=replica_count)
+        )
+        # Zero WAL header ring so recovery sees clean slots.
+        zeros = b"\x00" * 4096
+        off = zone.wal_headers_offset
+        end = off + zone.wal_headers_size
+        while off < end:
+            storage.write(off, zeros[: min(4096, end - off)])
+            off += 4096
+        storage.sync()
+
+    def open(self) -> None:
+        st = self.superblock.open()
+        assert st.cluster == self.cluster and st.replica == self.replica
+        self.view = st.view
+        self.log_view = st.log_view
+        self.commit_min = st.op_checkpoint
+        self.commit_max = max(st.commit_max, st.op_checkpoint)
+
+        if self.snapshot_store is not None and st.op_checkpoint > 0:
+            blob = self.snapshot_store.load()
+            assert blob is not None, "superblock references a checkpoint; snapshot missing"
+            self._load_snapshot(blob)
+
+        self.journal.recover(self.cluster)
+        self.op = max(self.journal.highest_op(), st.op_checkpoint)
+
+        # Re-execute contiguous committed prepares beyond the checkpoint.
+        replay_to = min(self.commit_max, self.op)
+        for op in range(st.op_checkpoint + 1, replay_to + 1):
+            msg = self.journal.read_prepare(op)
+            if msg is None:
+                break
+            self._execute(msg, replay=True)
+            self.commit_min = op
+        if self.replica_count == 1:
+            # Single replica: every durable prepare is committable.
+            for op in range(self.commit_min + 1, self.op + 1):
+                msg = self.journal.read_prepare(op)
+                if msg is None:
+                    self.op = op - 1  # torn tail — truncate
+                    break
+                self._execute(msg, replay=True)
+                self.commit_min = op
+            self.commit_max = max(self.commit_max, self.commit_min)
+        self.status = STATUS_NORMAL
+        self.on_event("open", self)
+
+    # ------------------------------------------------------------------
+    # ticks / timeouts
+
+    def tick(self) -> None:
+        self.tick_count += 1
+        if self.status == STATUS_NORMAL:
+            if self.is_primary:
+                if self.tick_count - self.last_commit_sent_tick >= COMMIT_HEARTBEAT_TIMEOUT:
+                    self._send_commit_heartbeat()
+                self._retry_pipeline()
+            else:
+                if self.tick_count - self.last_heartbeat_tick >= NORMAL_HEARTBEAT_TIMEOUT:
+                    self._start_view_change(self.view + 1)
+                self._repair_gaps()
+        elif self.status == STATUS_VIEW_CHANGE:
+            if self.tick_count - self.last_heartbeat_tick >= VIEW_CHANGE_TIMEOUT:
+                self._start_view_change(self.view + 1)
+
+    # ------------------------------------------------------------------
+    # message dispatch
+
+    def on_message(self, msg: Message) -> None:
+        if not msg.verify():
+            return
+        h = msg.header
+        if h["cluster"] != self.cluster:
+            return
+        cmd = h["command"]
+        handler = {
+            Command.REQUEST: self.on_request,
+            Command.PREPARE: self.on_prepare,
+            Command.PREPARE_OK: self.on_prepare_ok,
+            Command.COMMIT: self.on_commit,
+            Command.START_VIEW_CHANGE: self.on_start_view_change,
+            Command.DO_VIEW_CHANGE: self.on_do_view_change,
+            Command.START_VIEW: self.on_start_view,
+            Command.REQUEST_PREPARE: self.on_request_prepare,
+            Command.PING: self.on_ping,
+            Command.PONG: lambda m: None,
+        }.get(cmd)
+        if handler is not None:
+            handler(msg)
+
+    # --- normal protocol ------------------------------------------------
+
+    def on_ping(self, msg: Message) -> None:
+        pong = hdr.make(
+            Command.PONG, self.cluster, replica=self.replica, view=self.view
+        )
+        self.bus.send_to_replica(msg.header["replica"], Message(pong).seal())
+
+    def on_request(self, msg: Message) -> None:
+        if not self.is_primary:
+            # Forward to the primary (clients may be out of date).
+            if self.status == STATUS_NORMAL:
+                self.bus.send_to_replica(self.primary_index(self.view), msg)
+            return
+        h = msg.header
+        client = h["client"]
+        sess = self.clients.get(client)
+
+        if h["operation"] == Operation.REGISTER:
+            if sess is None:
+                # Session is created when the register op COMMITS (it is
+                # replicated state — reference client_sessions.zig); guard
+                # against duplicate registers already in the pipeline.
+                if not any(
+                    e.message.header["client"] == client
+                    and e.message.header["operation"] == Operation.REGISTER
+                    for e in self.pipeline
+                ):
+                    self._append_request(msg)
+            else:
+                self._reply_cached(client, sess)
+            return
+
+        if sess is None:
+            evict = hdr.make(
+                Command.EVICTION, self.cluster, client=client,
+                replica=self.replica, view=self.view,
+            )
+            self.bus.send_to_client(client, Message(evict).seal())
+            return
+        if h["request"] <= sess.request:
+            if h["request"] == sess.request and sess.reply is not None:
+                self.bus.send_to_client(client, sess.reply)
+            return
+        self._append_request(msg)
+
+    def _reply_cached(self, client: int, sess: ClientSession) -> None:
+        if sess.reply is not None:
+            self.bus.send_to_client(client, sess.reply)
+
+    def _evict_oldest_client(self) -> None:
+        oldest = min(self.clients, key=lambda c: self.clients[c].session)
+        del self.clients[oldest]
+
+    def _append_request(self, msg: Message) -> None:
+        if len(self.pipeline) >= self.config.pipeline_max:
+            self.request_queue.append(msg)
+            return
+        self._primary_prepare(msg)
+
+    def _primary_prepare(self, request: Message) -> None:
+        assert self.is_primary
+        self.op += 1
+        rh = request.header
+        n_events = (
+            (rh["size"] - hdr.HEADER_SIZE) // _event_dtype(rh["operation"]).itemsize
+            if rh["operation"] >= 128
+            else 0
+        )
+        sm = self.state_machine
+        base = max(sm.prepare_timestamp, self._realtime_ns())
+        timestamp = base + n_events if n_events else base + 1
+        sm.prepare_timestamp = timestamp
+
+        prev = self.journal.headers.get(self.journal.slot_for_op(self.op - 1))
+        ph = hdr.make(
+            Command.PREPARE, self.cluster,
+            view=self.view, op=self.op, commit=self.commit_min,
+            timestamp=timestamp, replica=self.replica,
+            operation=rh["operation"], client=rh["client"], request=rh["request"],
+            parent=(prev["checksum"] if prev is not None else 0),
+        )
+        prepare = Message(ph, request.body).seal()
+        entry = Pipeline(prepare)
+        self.pipeline.append(entry)
+        self.journal.write_prepare(prepare)
+        entry.ok_from.add(self.replica)
+        for r in range(self.replica_count):
+            if r != self.replica:
+                self.bus.send_to_replica(r, prepare)
+        self._check_pipeline_quorum()
+
+    def _retry_pipeline(self) -> None:
+        if not self.pipeline:
+            return
+        if self.tick_count % PREPARE_TIMEOUT == 0:
+            for entry in self.pipeline:
+                for r in range(self.replica_count):
+                    if r not in entry.ok_from:
+                        self.bus.send_to_replica(r, entry.message)
+
+    def on_prepare(self, msg: Message) -> None:
+        h = msg.header
+        if self.status != STATUS_NORMAL:
+            return
+        if h["view"] < self.view:
+            # A repair response: prepares keep their original view. Accept
+            # into the journal if the slot is missing, but never prepare_ok
+            # an old view (reference on_repair, replica.zig:1646).
+            if h["op"] <= self.op and self.journal.read_prepare(h["op"]) is None:
+                self.journal.write_prepare(msg)
+                self._commit_journal(self.commit_max)
+            return
+        if h["view"] > self.view:
+            self._start_view_change(h["view"])  # catch up via view change
+            return
+        self.last_heartbeat_tick = self.tick_count
+        if h["op"] <= self.op:
+            existing = self.journal.read_prepare(h["op"])
+            if existing is not None and existing.header["checksum"] == h["checksum"]:
+                self._send_prepare_ok(h)
+                self._commit_journal(h["commit"])
+                return
+            if existing is None or h["view"] >= existing.header["view"]:
+                # Re-proposed in a newer view (post view-change): overwrite.
+                self.journal.write_prepare(msg)
+                self._send_prepare_ok(h)
+                self._commit_journal(h["commit"])
+            return
+        if h["op"] != self.op + 1:
+            # Gap: remember commit target; repair will fetch missing ops.
+            self.commit_max = max(self.commit_max, h["commit"])
+            self._repair_gaps(target=h["op"])
+            return
+        self.op = h["op"]
+        self.journal.write_prepare(msg)
+        self._send_prepare_ok(h)
+        self._commit_journal(h["commit"])
+
+    def _send_prepare_ok(self, prepare_header: Header) -> None:
+        ok = hdr.make(
+            Command.PREPARE_OK, self.cluster,
+            view=self.view, op=prepare_header["op"],
+            parent=prepare_header["checksum"],
+            replica=self.replica, timestamp=prepare_header["timestamp"],
+        )
+        self.bus.send_to_replica(self.primary_index(self.view), Message(ok).seal())
+
+    def on_prepare_ok(self, msg: Message) -> None:
+        if not self.is_primary or msg.header["view"] != self.view:
+            return
+        op = msg.header["op"]
+        for entry in self.pipeline:
+            if entry.message.header["op"] == op:
+                if msg.header["parent"] == entry.message.header["checksum"]:
+                    entry.ok_from.add(msg.header["replica"])
+                break
+        self._check_pipeline_quorum()
+
+    def _check_pipeline_quorum(self) -> None:
+        while self.pipeline:
+            entry = self.pipeline[0]
+            if len(entry.ok_from) < self.quorum_replication:
+                break
+            op = entry.message.header["op"]
+            if op != self.commit_min + 1:
+                # Earlier ops (from before a view change) must commit through
+                # the journal first; _commit_journal re-checks the pipeline.
+                break
+            self.pipeline.pop(0)
+            self.commit_max = max(self.commit_max, op)
+            reply = self._execute(entry.message)
+            self.commit_min = op
+            self._maybe_checkpoint()
+            if reply is not None:
+                self.bus.send_to_client(entry.message.header["client"], reply)
+        while self.request_queue and len(self.pipeline) < self.config.pipeline_max:
+            self._primary_prepare(self.request_queue.pop(0))
+
+    def _send_commit_heartbeat(self) -> None:
+        self.last_commit_sent_tick = self.tick_count
+        ch = hdr.make(
+            Command.COMMIT, self.cluster,
+            view=self.view, commit=self.commit_min, replica=self.replica,
+        )
+        m = Message(ch).seal()
+        for r in range(self.replica_count):
+            if r != self.replica:
+                self.bus.send_to_replica(r, m)
+
+    def on_commit(self, msg: Message) -> None:
+        h = msg.header
+        if self.status != STATUS_NORMAL or h["view"] != self.view or self.is_primary:
+            return
+        self.last_heartbeat_tick = self.tick_count
+        self._commit_journal(h["commit"])
+
+    def _commit_journal(self, commit_target: int) -> None:
+        self.commit_max = max(self.commit_max, commit_target)
+        while self.commit_min < self.commit_max:
+            msg = self.journal.read_prepare(self.commit_min + 1)
+            if msg is None:
+                self._repair_gaps(target=self.commit_min + 1)
+                break
+            self._execute(msg)
+            self.commit_min += 1
+            self._maybe_checkpoint()
+        if self.is_primary and self.pipeline:
+            self._check_pipeline_quorum()
+
+    # --- repair ---------------------------------------------------------
+
+    def _repair_gaps(self, target: Optional[int] = None) -> None:
+        if self.tick_count - self.last_repair_tick < REPAIR_TIMEOUT and target is None:
+            return
+        self.last_repair_tick = self.tick_count
+        want = self.commit_min + 1
+        limit = target if target is not None else self.commit_max
+        count = 0
+        while want <= limit and count < 8:
+            if self.journal.read_prepare(want) is None:
+                rp = hdr.make(
+                    Command.REQUEST_PREPARE, self.cluster,
+                    view=self.view, op=want, replica=self.replica,
+                )
+                peer = self.primary_index(self.view)
+                if peer == self.replica:
+                    peer = (self.replica + 1) % self.replica_count
+                self.bus.send_to_replica(peer, Message(rp).seal())
+                count += 1
+            want += 1
+
+    def on_request_prepare(self, msg: Message) -> None:
+        m = self.journal.read_prepare(msg.header["op"])
+        if m is not None:
+            self.bus.send_to_replica(msg.header["replica"], m)
+
+    # --- view change ----------------------------------------------------
+
+    def _start_view_change(self, new_view: int) -> None:
+        if new_view <= self.view and self.status != STATUS_NORMAL:
+            new_view = self.view + 1
+        if self.status == STATUS_NORMAL:
+            self.log_view = self.view
+        self.status = STATUS_VIEW_CHANGE
+        self.view = max(self.view, new_view)
+        self.last_heartbeat_tick = self.tick_count
+        svc = hdr.make(
+            Command.START_VIEW_CHANGE, self.cluster,
+            view=new_view, replica=self.replica,
+        )
+        m = Message(svc).seal()
+        for r in range(self.replica_count):
+            if r != self.replica:
+                self.bus.send_to_replica(r, m)
+        self.start_view_change_from.setdefault(new_view, set()).add(self.replica)
+        self._maybe_send_do_view_change(new_view)
+
+    def on_start_view_change(self, msg: Message) -> None:
+        v = msg.header["view"]
+        if v < self.view:
+            return
+        self.start_view_change_from.setdefault(v, set()).add(msg.header["replica"])
+        if v > self.view and self.status == STATUS_NORMAL:
+            if len(self.start_view_change_from[v]) >= self.quorum_view_change - 1:
+                self._start_view_change(v)
+                return
+        self._maybe_send_do_view_change(v)
+
+    def _maybe_send_do_view_change(self, v: int) -> None:
+        if self.status != STATUS_VIEW_CHANGE or v != self.view:
+            return
+        if len(self.start_view_change_from.get(v, set())) < self.quorum_view_change:
+            return
+        if self._dvc_sent_for_view >= v:
+            return
+        self._dvc_sent_for_view = v
+        headers = self._recent_headers()
+        dvc = hdr.make(
+            Command.DO_VIEW_CHANGE, self.cluster,
+            view=v, replica=self.replica, op=self.op,
+            commit=self.commit_min, timestamp=self.log_view,
+        )
+        body = b"".join(h.to_bytes() for h in headers)
+        m = Message(dvc, body).seal()
+        primary = self.primary_index(v)
+        if primary == self.replica:
+            self.on_do_view_change(m)
+        else:
+            self.bus.send_to_replica(primary, m)
+
+    def _recent_headers(self) -> List[Header]:
+        out = []
+        for op in range(max(1, self.op - 32), self.op + 1):
+            h = self.journal.headers.get(self.journal.slot_for_op(op))
+            if h is not None and h["op"] == op:
+                out.append(h)
+        return out
+
+    def on_do_view_change(self, msg: Message) -> None:
+        v = msg.header["view"]
+        if v < self.view or self.primary_index(v) != self.replica:
+            return
+        if v > self.view:
+            self._start_view_change(v)
+        self.do_view_change_from.setdefault(v, {})[msg.header["replica"]] = msg
+        dvcs = self.do_view_change_from[v]
+        if len(dvcs) < self.quorum_view_change:
+            return
+        if self.status != STATUS_VIEW_CHANGE or self.view != v:
+            return
+
+        # Pick the log with the highest (log_view, op) — reference DVCQuorum.
+        best = max(
+            dvcs.values(),
+            key=lambda m: (m.header["timestamp"], m.header["op"]),  # timestamp=log_view
+        )
+        new_op = best.header["op"]
+        new_commit = max(m.header["commit"] for m in dvcs.values())
+
+        # Install headers from the winning DVC body; fetch missing prepares.
+        body = best.body
+        for i in range(len(body) // hdr.HEADER_SIZE):
+            h = Header.from_bytes(body[i * hdr.HEADER_SIZE : (i + 1) * hdr.HEADER_SIZE])
+            if h["op"] > self.op and self.journal.read_prepare(h["op"]) is None:
+                rp = hdr.make(
+                    Command.REQUEST_PREPARE, self.cluster,
+                    view=v, op=h["op"], replica=self.replica,
+                )
+                self.bus.send_to_replica(best.header["replica"], Message(rp).seal())
+
+        self.op = max(self.op, new_op)
+        self.commit_max = max(self.commit_max, new_commit)
+
+        # Become primary of the new view.
+        self.status = STATUS_NORMAL
+        self.log_view = v
+        self.pipeline = []
+        self.request_queue = []
+        self._persist_view()
+        sv = hdr.make(
+            Command.START_VIEW, self.cluster,
+            view=v, replica=self.replica, op=self.op, commit=self.commit_min,
+        )
+        body = b"".join(h.to_bytes() for h in self._recent_headers())
+        m = Message(sv, body).seal()
+        for r in range(self.replica_count):
+            if r != self.replica:
+                self.bus.send_to_replica(r, m)
+        self._commit_journal(self.commit_max)
+        self._reproposal_pipeline(v)
+        self.on_event("view_change", self)
+
+    def _reproposal_pipeline(self, v: int) -> None:
+        """Re-propose uncommitted journal ops in the new view so they can
+        collect prepare_ok quorums (reference primary repair after
+        start_view; replica.zig pipeline reconstruction)."""
+        for op in range(self.commit_min + 1, self.op + 1):
+            msg = self.journal.read_prepare(op)
+            if msg is None:
+                break  # will arrive via repair; re-proposed on a later pass
+            h = msg.header
+            prev = self.journal.headers.get(self.journal.slot_for_op(op - 1))
+            nh = hdr.make(
+                Command.PREPARE, self.cluster,
+                view=v, op=op, commit=self.commit_min,
+                timestamp=h["timestamp"], replica=self.replica,
+                operation=h["operation"], client=h["client"], request=h["request"],
+                parent=(prev["checksum"] if prev is not None else 0),
+            )
+            prepare = Message(nh, msg.body).seal()
+            self.journal.write_prepare(prepare)
+            entry = Pipeline(prepare)
+            entry.ok_from.add(self.replica)
+            self.pipeline.append(entry)
+            for r in range(self.replica_count):
+                if r != self.replica:
+                    self.bus.send_to_replica(r, prepare)
+
+    def on_start_view(self, msg: Message) -> None:
+        h = msg.header
+        v = h["view"]
+        if v < self.view or (v == self.view and self.status == STATUS_NORMAL):
+            return
+        self.view = v
+        self.log_view = v
+        self.status = STATUS_NORMAL
+        self.last_heartbeat_tick = self.tick_count
+        self.op = max(self.op, h["op"])
+        self._persist_view()
+        self._commit_journal(h["commit"])
+        self.on_event("view_change", self)
+
+    def _persist_view(self) -> None:
+        st = self.superblock.state
+        st.view = self.view
+        st.log_view = self.log_view
+        self.superblock.checkpoint()
+
+    # --- execution ------------------------------------------------------
+
+    def _realtime_ns(self) -> int:
+        # Deterministic logical clock: ticks as nanoseconds. A Marzullo
+        # cluster clock (reference vsr/clock.zig) is a later round.
+        return self.tick_count
+
+    def _execute(self, prepare: Message, replay: bool = False) -> Optional[Message]:
+        h = prepare.header
+        op_num = h["op"]
+        operation = h["operation"]
+        sm = self.state_machine
+        body = prepare.body
+        results: bytes
+
+        if operation >= 128:
+            events = np.frombuffer(bytearray(body), dtype=_event_dtype(operation))
+            if operation == Operation.CREATE_ACCOUNTS:
+                res = sm.create_accounts(events, timestamp=h["timestamp"])
+                sm.prepare_timestamp = max(sm.prepare_timestamp, h["timestamp"])
+                results = res.tobytes()
+            elif operation == Operation.CREATE_TRANSFERS:
+                res = sm.create_transfers(events, timestamp=h["timestamp"])
+                sm.prepare_timestamp = max(sm.prepare_timestamp, h["timestamp"])
+                results = res.tobytes()
+            elif operation == Operation.LOOKUP_ACCOUNTS:
+                recs = sm.lookup_accounts(events["lo"], events["hi"])
+                results = recs.tobytes()
+            elif operation == Operation.LOOKUP_TRANSFERS:
+                recs = sm.lookup_transfers(events["lo"], events["hi"])
+                results = recs.tobytes()
+            elif operation == Operation.GET_ACCOUNT_TRANSFERS:
+                results = self._get_account_transfers(events[0]).tobytes()
+            elif operation == Operation.GET_ACCOUNT_HISTORY:
+                results = self._get_account_history(events[0]).tobytes()
+            else:
+                results = b""
+        else:
+            results = b""  # register / root
+
+        # State hash chain: op + results (prepare checksums are excluded —
+        # re-proposed prepares legitimately differ across views).
+        self.commit_checksums[op_num] = hdr.checksum(
+            op_num.to_bytes(8, "little") + results
+        )
+        self.on_event("commit", self)
+
+        # Client-table update is replicated state: every replica applies it
+        # at commit (reference client_sessions.zig + commit_op :3777-3815).
+        client = h["client"]
+        reply: Optional[Message] = None
+        if client != 0:
+            rh = hdr.make(
+                Command.REPLY, self.cluster,
+                view=self.view, op=op_num, commit=op_num,
+                timestamp=h["timestamp"], client=client, request=h["request"],
+                replica=self.replica, operation=operation,
+            )
+            reply = Message(rh, results).seal()
+            if operation == Operation.REGISTER:
+                if len(self.clients) >= self.config.clients_max:
+                    self._evict_oldest_client()
+                self.clients[client] = ClientSession(session=op_num)
+            sess = self.clients.get(client)
+            if sess is not None:
+                sess.request = h["request"]
+                sess.reply = reply
+        if replay:
+            return None
+        return reply
+
+    def _get_account_transfers(self, f: np.void) -> np.ndarray:
+        return self.state_machine.get_account_transfers(
+            account_id=int(f["account_id_lo"]) | (int(f["account_id_hi"]) << 64),
+            timestamp_min=int(f["timestamp_min"]),
+            timestamp_max=int(f["timestamp_max"]),
+            limit=int(f["limit"]),
+            flags=int(f["flags"]),
+        )
+
+    def _get_account_history(self, f: np.void) -> np.ndarray:
+        rows = self.state_machine.get_account_history(
+            account_id=int(f["account_id_lo"]) | (int(f["account_id_hi"]) << 64),
+            timestamp_min=int(f["timestamp_min"]),
+            timestamp_max=int(f["timestamp_max"]),
+            limit=int(f["limit"]),
+            flags=int(f["flags"]),
+        )
+        out = np.zeros(len(rows), dtype=types.ACCOUNT_BALANCE_DTYPE)
+        for i, (ts, dp, dpo, cp, cpo) in enumerate(rows):
+            out[i]["timestamp"] = ts
+            for name, v in (
+                ("debits_pending", dp), ("debits_posted", dpo),
+                ("credits_pending", cp), ("credits_posted", cpo),
+            ):
+                out[i][name + "_lo"] = v & ((1 << 64) - 1)
+                out[i][name + "_hi"] = v >> 64
+        return out
+
+    # --- checkpoint -----------------------------------------------------
+
+    def _maybe_checkpoint(self) -> None:
+        interval = self.config.checkpoint_interval
+        if self.commit_min == 0 or self.commit_min % interval != 0:
+            return
+        if self.commit_min <= self.superblock.state.op_checkpoint:
+            return
+        if self.snapshot_store is not None:
+            self.snapshot_store.save(self._save_snapshot())
+        st = self.superblock.state
+        st.op_checkpoint = self.commit_min
+        st.commit_min = self.commit_min
+        st.commit_max = self.commit_max
+        st.view = self.view
+        st.log_view = self.log_view
+        st.prepare_timestamp = self.state_machine.prepare_timestamp
+        st.commit_timestamp = self.state_machine.commit_timestamp
+        self.superblock.checkpoint()
+        self.on_event("checkpoint", self)
+
+    def _save_snapshot(self) -> bytes:
+        sm = self.state_machine
+        count = sm.account_count
+        dp, dpo, cp, cpo = sm._read_balances(np.arange(count, dtype=np.int64))
+        buf = _io.BytesIO()
+        np.savez(
+            buf,
+            account_count=np.int64(count),
+            acc_key_hi=sm.acc_key["hi"][:count], acc_key_lo=sm.acc_key["lo"][:count],
+            acc_ud128_lo=sm.acc_user_data_128_lo[:count],
+            acc_ud128_hi=sm.acc_user_data_128_hi[:count],
+            acc_ud64=sm.acc_user_data_64[:count], acc_ud32=sm.acc_user_data_32[:count],
+            acc_ledger=sm.acc_ledger[:count], acc_code=sm.acc_code[:count],
+            acc_flags=sm.acc_flags[:count], acc_ts=sm.acc_timestamp[:count],
+            bal_dp=dp, bal_dpo=dpo, bal_cp=cp, bal_cpo=cpo,
+            transfers=sm.transfer_log.scan(),
+            posted_keys=np.array(list(sm.posted.keys()), dtype=np.uint64),
+            posted_vals=np.array(list(sm.posted.values()), dtype=np.uint8),
+            history=np.array(
+                [
+                    (
+                        r.timestamp,
+                        r.dr_account_id & ((1 << 64) - 1), r.dr_account_id >> 64,
+                        r.dr_debits_pending, r.dr_debits_posted,
+                        r.dr_credits_pending, r.dr_credits_posted,
+                        r.cr_account_id & ((1 << 64) - 1), r.cr_account_id >> 64,
+                        r.cr_debits_pending, r.cr_debits_posted,
+                        r.cr_credits_pending, r.cr_credits_posted,
+                    )
+                    for r in sm.history
+                ],
+                dtype=object,
+            ) if sm.history else np.zeros((0,), dtype=object),
+            prepare_timestamp=np.uint64(sm.prepare_timestamp),
+            commit_timestamp=np.uint64(sm.commit_timestamp),
+            # Client table (reference client_sessions + client_replies zones).
+            client_table=np.array(
+                [
+                    (cid, s.session, s.request,
+                     s.reply.to_bytes() if s.reply is not None else b"")
+                    for cid, s in self.clients.items()
+                ],
+                dtype=object,
+            ) if self.clients else np.zeros((0,), dtype=object),
+        )
+        return buf.getvalue()
+
+    def _load_snapshot(self, blob: bytes) -> None:
+        from tigerbeetle_tpu.lsm.store import pack_keys
+        from tigerbeetle_tpu.models.oracle import HistoryRow
+
+        z = np.load(_io.BytesIO(blob), allow_pickle=True)
+        sm = self.state_machine
+        count = int(z["account_count"])
+        sm.account_count = count
+        keys = pack_keys(z["acc_key_lo"], z["acc_key_hi"])
+        sm.acc_key[:count] = keys
+        sm.acc_user_data_128_lo[:count] = z["acc_ud128_lo"]
+        sm.acc_user_data_128_hi[:count] = z["acc_ud128_hi"]
+        sm.acc_user_data_64[:count] = z["acc_ud64"]
+        sm.acc_user_data_32[:count] = z["acc_ud32"]
+        sm.acc_ledger[:count] = z["acc_ledger"]
+        sm.acc_code[:count] = z["acc_code"]
+        sm.acc_flags[:count] = z["acc_flags"]
+        sm.acc_timestamp[:count] = z["acc_ts"]
+        sm.account_index.insert_batch(keys, np.arange(count, dtype=np.uint32))
+        sm._register_accounts(
+            np.arange(count, dtype=np.int32), z["acc_ledger"], z["acc_flags"],
+            np.ones(count, dtype=bool),
+        )
+        sm._write_balances(
+            np.arange(count, dtype=np.int32),
+            z["bal_dp"], z["bal_dpo"], z["bal_cp"], z["bal_cpo"],
+        )
+        transfers = z["transfers"]
+        if len(transfers):
+            transfers = transfers.view(types.TRANSFER_DTYPE) if transfers.dtype != types.TRANSFER_DTYPE else transfers
+            rows = sm.transfer_log.append_batch(transfers)
+            sm.transfer_index.insert_batch(
+                pack_keys(transfers["id_lo"], transfers["id_hi"]), rows
+            )
+        sm.posted = {
+            int(k): int(v) for k, v in zip(z["posted_keys"], z["posted_vals"])
+        }
+        for row in z["history"]:
+            sm.history.append(
+                HistoryRow(
+                    timestamp=int(row[0]),
+                    dr_account_id=int(row[1]) | (int(row[2]) << 64),
+                    dr_debits_pending=int(row[3]), dr_debits_posted=int(row[4]),
+                    dr_credits_pending=int(row[5]), dr_credits_posted=int(row[6]),
+                    cr_account_id=int(row[7]) | (int(row[8]) << 64),
+                    cr_debits_pending=int(row[9]), cr_debits_posted=int(row[10]),
+                    cr_credits_pending=int(row[11]), cr_credits_posted=int(row[12]),
+                )
+            )
+        sm.prepare_timestamp = int(z["prepare_timestamp"])
+        sm.commit_timestamp = int(z["commit_timestamp"])
+        for row in z["client_table"]:
+            sess = ClientSession(session=int(row[1]))
+            sess.request = int(row[2])
+            sess.reply = Message.from_bytes(row[3]) if len(row[3]) else None
+            self.clients[int(row[0])] = sess
