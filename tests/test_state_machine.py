@@ -24,9 +24,9 @@ from tigerbeetle_tpu.results import CreateTransferResult as TR
 CFG = Config(name="unit", accounts_max=1 << 12, transfers_max=1 << 14, batch_max=64)
 
 
-def run_both(account_batches, transfer_batches):
+def run_both(account_batches, transfer_batches, backend="jax"):
     """Run the same batches through StateMachine and Oracle; compare exactly."""
-    sm = StateMachine(CFG)
+    sm = StateMachine(CFG, backend=backend)
     orc = Oracle()
     for batch in account_batches:
         ts = orc.prepare("create_accounts", len(batch))
@@ -421,3 +421,62 @@ class TestReadOps:
         sm = StateMachine(CFG)
         out = sm.lookup_accounts(np.array([5], dtype=np.uint64), np.array([0], dtype=np.uint64))
         assert len(out) == 0
+
+
+class TestNumpyBackend:
+    """The CPU-fallback fast path (models/host_kernel.py) must be byte-exact
+    too — rerun the representative suites with backend='numpy'."""
+
+    def test_simple_transfers_numpy(self):
+        accounts = simple_accounts(4)
+        transfers = types.batch(
+            [
+                types.transfer(id=100 + i, debit_account_id=1 + (i % 3),
+                               credit_account_id=4, amount=10 + i, ledger=1, code=7)
+                for i in range(16)
+            ],
+            types.TRANSFER_DTYPE,
+        )
+        sm, orc = run_both([accounts], [transfers], backend="numpy")
+        assert sm.stats["fast_batches"] == 1
+
+    def test_validation_errors_numpy(self):
+        accounts = simple_accounts(3)
+        bad = [
+            types.transfer(id=0, debit_account_id=1, credit_account_id=2, amount=1, ledger=1, code=1),
+            types.transfer(id=201, debit_account_id=0, credit_account_id=2, amount=1, ledger=1, code=1),
+            types.transfer(id=203, debit_account_id=1, credit_account_id=2, amount=0, ledger=1, code=1),
+            types.transfer(id=206, debit_account_id=1, credit_account_id=2, amount=1, ledger=1, code=1, timeout=5),
+            types.transfer(id=207, debit_account_id=99, credit_account_id=2, amount=1, ledger=1, code=1),
+            types.transfer(id=211, debit_account_id=1, credit_account_id=2, amount=1, ledger=1, code=1, timestamp=77),
+            types.transfer(id=212, debit_account_id=1, credit_account_id=2, amount=1, ledger=1, code=1,
+                           flags=TransferFlags.PENDING, timeout=3),
+        ]
+        run_both([accounts], [types.batch(bad, types.TRANSFER_DTYPE)], backend="numpy")
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_random_workload_numpy(self, seed):
+        rng = np.random.default_rng(4000 + seed)
+        accounts = simple_accounts(16)
+        batches = []
+        next_id = 1
+        for _ in range(4):
+            bn = int(rng.integers(8, 48))
+            batch = []
+            for _ in range(bn):
+                batch.append(
+                    types.transfer(
+                        id=next_id,
+                        debit_account_id=int(rng.integers(0, 18)),
+                        credit_account_id=int(rng.integers(1, 18)),
+                        amount=int(rng.integers(0, 1000)),
+                        ledger=int(rng.integers(1, 3)),
+                        code=int(rng.integers(0, 3)),
+                        flags=int(TransferFlags.PENDING) if rng.random() < 0.3 else 0,
+                        timeout=int(rng.integers(0, 3)),
+                    )
+                )
+                next_id += 1
+            batches.append(types.batch(batch, types.TRANSFER_DTYPE))
+        sm, orc = run_both([accounts], batches, backend="numpy")
+        assert sm.stats["fast_batches"] >= 2

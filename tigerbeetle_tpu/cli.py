@@ -45,25 +45,42 @@ def cmd_format(args) -> int:
 
 
 class FileSnapshotStore:
-    def __init__(self, path: str) -> None:
-        self.path = path + ".snapshot"
+    """Op-tagged snapshot files: <path>.snapshot.<op>; older ops are pruned
+    only after the superblock checkpoint is durable."""
 
-    def save(self, blob: bytes) -> None:
+    def __init__(self, path: str) -> None:
+        self.base = path + ".snapshot"
+
+    def _path(self, op: int) -> str:
+        return f"{self.base}.{op}"
+
+    def save(self, op: int, blob: bytes) -> None:
         import os
 
-        tmp = self.path + ".tmp"
+        tmp = self._path(op) + ".tmp"
         with open(tmp, "wb") as f:
             f.write(blob)
             f.flush()
             os.fsync(f.fileno())
-        os.replace(tmp, self.path)
+        os.replace(tmp, self._path(op))
 
-    def load(self):
+    def load(self, op: int):
         try:
-            with open(self.path, "rb") as f:
+            with open(self._path(op), "rb") as f:
                 return f.read()
         except FileNotFoundError:
             return None
+
+    def prune(self, keep_op: int) -> None:
+        import glob
+        import os
+
+        for p in glob.glob(self.base + ".*"):
+            if not p.endswith(f".{keep_op}") and not p.endswith(".tmp"):
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
 
 
 def cmd_start(args) -> int:
@@ -78,14 +95,6 @@ def cmd_start(args) -> int:
     )
     addresses = parse_addresses(args.addresses)
     storage = FileStorage(args.path)
-
-    class _NullBus:
-        def send_to_replica(self, r, m):
-            pass
-
-        def send_to_client(self, c, m):
-            pass
-
     replica = Replica(
         cluster=args.cluster,
         replica_index=args.replica,
@@ -93,7 +102,7 @@ def cmd_start(args) -> int:
         storage=storage,
         zone=zone,
         config=config,
-        bus=_NullBus(),
+        bus=None,  # injected by ReplicaServer
         snapshot_store=FileSnapshotStore(args.path),
         sm_backend=args.backend,
     )

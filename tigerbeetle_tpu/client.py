@@ -42,86 +42,127 @@ class Client:
         self.cluster = cluster
         self.id = client_id if client_id is not None else secrets.randbits(127) | 1
         self.request_number = 0
-        self._sock: Optional[socket.socket] = None
+        # One connection per replica (the reference client connects to all,
+        # message_bus.zig:24): the reply may come from whichever replica is
+        # primary, not necessarily the one the request was sent to.
+        self._socks: dict[int, socket.socket] = {}
+        self._bufs: dict[int, bytes] = {}
         self._target = 0
-        self._buf = b""
         self.register()
 
     # --- wire -----------------------------------------------------------
 
-    def _connect(self) -> None:
-        if self._sock is not None:
+    def _connect(self, r: int) -> Optional[socket.socket]:
+        old = self._socks.pop(r, None)
+        if old is not None:
             try:
-                self._sock.close()
+                old.close()
             except OSError:
                 pass
-        for _ in range(len(self.addresses)):
-            host, port = self.addresses[self._target % len(self.addresses)]
-            try:
-                self._sock = socket.create_connection((host, port), timeout=self.REQUEST_TIMEOUT)
-                self._sock.settimeout(self.REQUEST_TIMEOUT)
-                self._buf = b""
-                return
-            except OSError:
-                self._target += 1
-        raise ClientError(f"no replica reachable at {self.addresses}")
+        host, port = self.addresses[r]
+        try:
+            s = socket.create_connection((host, port), timeout=self.REQUEST_TIMEOUT)
+        except OSError:
+            return None
+        s.setblocking(False)
+        self._socks[r] = s
+        self._bufs[r] = b""
+        # Announce our client id so this replica can route replies to us.
+        hello = hdr.make(
+            Command.PING_CLIENT, self.cluster, client=self.id
+        )
+        try:
+            s.sendall(Message(hello).seal().to_bytes())
+        except OSError:
+            return None
+        return s
 
-    def _recv_message(self) -> Optional[Message]:
-        assert self._sock is not None
-        while True:
-            if len(self._buf) >= HEADER_SIZE:
-                h = Header.from_bytes(self._buf[:HEADER_SIZE])
-                size = h["size"]
-                if len(self._buf) >= size:
-                    raw = self._buf[:size]
-                    self._buf = self._buf[size:]
-                    msg = Message.from_bytes(raw)
-                    if msg.verify():
-                        return msg
-                    continue
-            try:
-                chunk = self._sock.recv(1 << 16)
-            except socket.timeout:
-                return None
-            except OSError:
-                return None
-            if not chunk:
-                return None
-            self._buf += chunk
+    def _ensure_connections(self) -> None:
+        for r in range(len(self.addresses)):
+            if r not in self._socks:
+                self._connect(r)
+        if not self._socks:
+            raise ClientError(f"no replica reachable at {self.addresses}")
+
+    def _pump(self, r: int) -> list[Message]:
+        """Drain readable bytes from replica r's socket into messages."""
+        import select as _select
+
+        s = self._socks.get(r)
+        if s is None:
+            return []
+        out = []
+        try:
+            while True:
+                chunk = s.recv(1 << 16)
+                if not chunk:
+                    self._socks.pop(r, None)
+                    break
+                self._bufs[r] += chunk
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError:
+            self._socks.pop(r, None)
+        buf = self._bufs.get(r, b"")
+        while len(buf) >= HEADER_SIZE:
+            h = Header.from_bytes(buf[:HEADER_SIZE])
+            size = h["size"]
+            if len(buf) < size:
+                break
+            raw, buf = buf[:size], buf[size:]
+            msg = Message.from_bytes(raw)
+            if msg.verify():
+                out.append(msg)
+        self._bufs[r] = buf
+        return out
 
     def _roundtrip(self, operation: int, body: bytes) -> Message:
+        import select as _select
+
         self.request_number += 1
         req = hdr.make(
             Command.REQUEST, self.cluster,
             client=self.id, request=self.request_number, operation=operation,
         )
         msg = Message(req, body).seal()
-        deadline_attempts = 4 * len(self.addresses) + 4
-        for _ in range(deadline_attempts):
-            if self._sock is None:
-                self._connect()
-            try:
-                self._sock.sendall(msg.to_bytes())
-            except OSError:
+        attempts = 4 * len(self.addresses) + 4
+        for _ in range(attempts):
+            self._ensure_connections()
+            target = self._target % len(self.addresses)
+            s = self._socks.get(target) or self._connect(target)
+            if s is None:
                 self._target += 1
-                self._sock = None
                 continue
-            start = time.monotonic()
-            while time.monotonic() - start < self.REQUEST_TIMEOUT:
-                reply = self._recv_message()
-                if reply is None:
+            try:
+                s.sendall(msg.to_bytes())
+            except OSError:
+                self._socks.pop(target, None)
+                self._target += 1
+                continue
+            deadline = time.monotonic() + self.REQUEST_TIMEOUT
+            while time.monotonic() < deadline:
+                socks = list(self._socks.values())
+                if not socks:
                     break
-                h = reply.header
-                if h["command"] == Command.EVICTION:
-                    raise SessionEvicted("session evicted by cluster")
-                if (
-                    h["command"] == Command.REPLY
-                    and h["client"] == self.id
-                    and h["request"] == self.request_number
-                ):
-                    return reply
+                readable, _, _ = _select.select(
+                    socks, [], [], max(0.0, deadline - time.monotonic())
+                )
+                if not readable:
+                    break
+                for r, sk in list(self._socks.items()):
+                    if sk in readable:
+                        for reply in self._pump(r):
+                            h = reply.header
+                            if h["command"] == Command.EVICTION:
+                                raise SessionEvicted("session evicted by cluster")
+                            if (
+                                h["command"] == Command.REPLY
+                                and h["client"] == self.id
+                                and h["request"] == self.request_number
+                            ):
+                                self._target = h["replica"]
+                                return reply
             self._target += 1
-            self._sock = None
         raise ClientError("request timed out against every replica")
 
     # --- session --------------------------------------------------------
@@ -130,9 +171,12 @@ class Client:
         self._roundtrip(Operation.REGISTER, b"")
 
     def close(self) -> None:
-        if self._sock is not None:
-            self._sock.close()
-            self._sock = None
+        for s in self._socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._socks = {}
 
     # --- typed operations ----------------------------------------------
 

@@ -1,0 +1,188 @@
+"""Vectorized numpy fast path — the CPU-fallback commit kernel.
+
+Mirrors the device kernel (ops/commit.py) in numpy: the same validation
+ladder, the same nonzero-minimum code merge, and exact u128 posting via
+u32-half accumulation with explicit carries. Used when the StateMachine runs
+with backend="numpy" (no accelerator present — the north star's "CPU
+fallback when no device"); preconditions are identical to the device fast
+path (the dispatcher in models/state_machine.py guarantees them).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+from tigerbeetle_tpu.constants import NS_PER_S
+from tigerbeetle_tpu.results import CreateTransferResult as TR
+
+U64_MAX = np.uint64(0xFFFFFFFFFFFFFFFF)
+MASK32 = np.uint64(0xFFFFFFFF)
+
+F_PENDING = 1 << 1
+
+
+def _ladder(code: np.ndarray, cond: np.ndarray, result) -> None:
+    np.copyto(code, np.uint32(int(result)), where=(code == 0) & cond)
+
+
+def validate(
+    events: np.ndarray,
+    ts: np.ndarray,
+    dr_slots: np.ndarray,
+    cr_slots: np.ndarray,
+    acc_ledger: np.ndarray,
+    host_code: np.ndarray,
+) -> np.ndarray:
+    """The device validation ladder (ops/commit.validate_simple) in numpy,
+    merged with host_code at exact precedence (nonzero minimum)."""
+    n = len(events)
+    flags = events["flags"].astype(np.uint32)
+    code = np.zeros(n, dtype=np.uint32)
+
+    _ladder(code, (flags & np.uint32(0xFFC0)) != 0, TR.RESERVED_FLAG)
+    id_zero = (events["id_lo"] == 0) & (events["id_hi"] == 0)
+    id_max = (events["id_lo"] == U64_MAX) & (events["id_hi"] == U64_MAX)
+    _ladder(code, id_zero, TR.ID_MUST_NOT_BE_ZERO)
+    _ladder(code, id_max, TR.ID_MUST_NOT_BE_INT_MAX)
+
+    pend = (flags & F_PENDING) != 0
+    _ladder(
+        code,
+        (events["pending_id_lo"] != 0) | (events["pending_id_hi"] != 0),
+        TR.PENDING_ID_MUST_BE_ZERO,
+    )
+    _ladder(code, ~pend & (events["timeout"] != 0), TR.TIMEOUT_RESERVED_FOR_PENDING_TRANSFER)
+    _ladder(code, (events["amount_lo"] == 0) & (events["amount_hi"] == 0),
+            TR.AMOUNT_MUST_NOT_BE_ZERO)
+    _ladder(code, events["ledger"] == 0, TR.LEDGER_MUST_NOT_BE_ZERO)
+    _ladder(code, events["code"] == 0, TR.CODE_MUST_NOT_BE_ZERO)
+
+    dr_found = dr_slots >= 0
+    cr_found = cr_slots >= 0
+    _ladder(code, ~dr_found, TR.DEBIT_ACCOUNT_NOT_FOUND)
+    _ladder(code, ~cr_found, TR.CREDIT_ACCOUNT_NOT_FOUND)
+
+    dr_ix = np.clip(dr_slots, 0, len(acc_ledger) - 1)
+    cr_ix = np.clip(cr_slots, 0, len(acc_ledger) - 1)
+    dr_ledger = acc_ledger[dr_ix]
+    cr_ledger = acc_ledger[cr_ix]
+    _ladder(code, dr_ledger != cr_ledger, TR.ACCOUNTS_MUST_HAVE_THE_SAME_LEDGER)
+    _ladder(code, events["ledger"].astype(np.uint32) != dr_ledger,
+            TR.TRANSFER_MUST_HAVE_THE_SAME_LEDGER_AS_ACCOUNTS)
+
+    # overflows_timeout: timestamp + timeout * 1e9 > u64 max (exact in u64:
+    # timeout < 2^32, so the product < 2^62; check via the subtraction form).
+    timeout_ns = events["timeout"].astype(np.uint64) * np.uint64(NS_PER_S)
+    _ladder(code, timeout_ns > U64_MAX - ts, TR.OVERFLOWS_TIMEOUT)
+
+    big = np.uint32(0xFFFFFFFF)
+    merged = np.minimum(
+        np.where(code == 0, big, code), np.where(host_code == 0, big, host_code)
+    )
+    return np.where(merged == big, np.uint32(0), merged)
+
+
+def _segment_sums_u128(
+    slots: np.ndarray, lo: np.ndarray, hi: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Exact per-slot sums of u128 (lo, hi u64) amounts.
+
+    Returns (uniq_slots, sum_lo, sum_hi, overflowed) — u32-half accumulation
+    in u64 cells, carries propagated, so sums are exact for n < 2^32.
+    """
+    uniq, inv = np.unique(slots, return_inverse=True)
+    k = len(uniq)
+    acc = np.zeros((k, 4), dtype=np.uint64)  # four u32-half accumulators
+    np.add.at(acc[:, 0], inv, lo & MASK32)
+    np.add.at(acc[:, 1], inv, lo >> np.uint64(32))
+    np.add.at(acc[:, 2], inv, hi & MASK32)
+    np.add.at(acc[:, 3], inv, hi >> np.uint64(32))
+    # carry-propagate halves into (lo, hi) u64 pairs
+    h0 = acc[:, 0]
+    h1 = acc[:, 1] + (h0 >> np.uint64(32))
+    h2 = acc[:, 2] + (h1 >> np.uint64(32))
+    h3 = acc[:, 3] + (h2 >> np.uint64(32))
+    sum_lo = (h0 & MASK32) | ((h1 & MASK32) << np.uint64(32))
+    sum_hi = (h2 & MASK32) | ((h3 & MASK32) << np.uint64(32))
+    over = (h3 >> np.uint64(32)) != 0
+    return uniq, sum_lo, sum_hi, over
+
+
+def _add_u128(
+    a_lo: np.ndarray, a_hi: np.ndarray, b_lo: np.ndarray, b_hi: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    lo = a_lo + b_lo
+    carry = (lo < a_lo).astype(np.uint64)
+    hi = a_hi + b_hi
+    over = hi < a_hi
+    hi2 = hi + carry
+    over = over | (hi2 < carry)
+    return lo, hi2, over
+
+
+def post(
+    balances: Dict[str, np.ndarray],  # four (A, 4)-u32 limb tables
+    dr_slots: np.ndarray,
+    cr_slots: np.ndarray,
+    amount_lo: np.ndarray,
+    amount_hi: np.ndarray,
+    pend_mask: np.ndarray,
+    post_mask: np.ndarray,
+) -> bool:
+    """Two-phase posting: compute all new rows and overflow flags first,
+    write only if nothing overflowed. Returns True on overflow (caller redoes
+    the batch serially; tables are untouched in that case)."""
+    from tigerbeetle_tpu import types
+
+    overflow = False
+    writes = []  # (field, uniq, new_lo, new_hi)
+    pending_new: Dict[str, Tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
+    for side_slots, side_mask, field in (
+        (dr_slots, pend_mask, "debits_pending"),
+        (dr_slots, post_mask, "debits_posted"),
+        (cr_slots, pend_mask, "credits_pending"),
+        (cr_slots, post_mask, "credits_posted"),
+    ):
+        m = side_mask
+        if not m.any():
+            continue
+        uniq, s_lo, s_hi, over = _segment_sums_u128(
+            side_slots[m], amount_lo[m], amount_hi[m]
+        )
+        overflow |= bool(over.any())
+        cur_lo, cur_hi = types.limbs_to_u64_pair(balances[field][uniq])
+        new_lo, new_hi, o2 = _add_u128(cur_lo, cur_hi, s_lo, s_hi)
+        overflow |= bool(o2.any())
+        writes.append((field, uniq, new_lo, new_hi))
+        pending_new[field] = (uniq, new_lo, new_hi)
+
+    # Combined pending+posted overflow per touched account, evaluated on the
+    # would-be-new values (monotone — batch-final totals suffice).
+    def value_at(field: str, slots: np.ndarray):
+        cur_lo, cur_hi = types.limbs_to_u64_pair(balances[field][slots])
+        if field in pending_new:
+            uniq, new_lo, new_hi = pending_new[field]
+            ix = np.searchsorted(uniq, slots)
+            ixc = np.minimum(ix, len(uniq) - 1)
+            hit = (ix < len(uniq)) & (uniq[ixc] == slots)
+            cur_lo = np.where(hit, new_lo[ixc], cur_lo)
+            cur_hi = np.where(hit, new_hi[ixc], cur_hi)
+        return cur_lo, cur_hi
+
+    active = pend_mask | post_mask
+    touched = np.unique(np.concatenate([dr_slots[active], cr_slots[active]]))
+    if len(touched):
+        for a, b in (("debits_pending", "debits_posted"),
+                     ("credits_pending", "credits_posted")):
+            a_lo, a_hi = value_at(a, touched)
+            b_lo, b_hi = value_at(b, touched)
+            _, _, o = _add_u128(a_lo, a_hi, b_lo, b_hi)
+            overflow |= bool(o.any())
+
+    if overflow:
+        return True
+    for field, uniq, new_lo, new_hi in writes:
+        balances[field][uniq] = types.u64_pair_to_limbs(new_lo, new_hi)
+    return False

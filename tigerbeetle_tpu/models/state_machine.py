@@ -342,7 +342,7 @@ class StateMachine:
             hard = bool(np.any(sk[1:] == sk[:-1]))
         if not hard:
             hard = self.transfer_index.contains_any(keys)
-        if hard or self._ops is None:
+        if hard:
             self.stats["serial_batches"] += 1
             return self._create_transfers_serial(events, timestamp)
 
@@ -385,6 +385,11 @@ class StateMachine:
         ladder(cr_max, TR.CREDIT_ACCOUNT_ID_MUST_NOT_BE_INT_MAX)
         ladder(same, TR.ACCOUNTS_MUST_BE_DIFFERENT)
 
+        if self._ops is None:
+            return self._create_transfers_numpy_fast(
+                events, ts, keys, dr_slots, cr_slots, host_code
+            )
+
         # Pad to a power-of-two bucket so the kernel compiles once per bucket
         # size, not per batch length. Padding events carry a nonzero host code
         # (never applied) and are stripped from the results.
@@ -421,6 +426,37 @@ class StateMachine:
         codes = np.asarray(codes_dev)[:n]
 
         ok = codes == 0
+        if np.any(ok):
+            recs = events[ok].copy()
+            recs["timestamp"] = ts[ok]
+            rows = self.transfer_log.append_batch(recs)
+            self.transfer_index.insert_batch(keys[ok], rows)
+            self.commit_timestamp = int(ts[ok][-1])
+        return _codes_to_results(codes)
+
+    def _create_transfers_numpy_fast(
+        self, events, ts, keys, dr_slots, cr_slots, host_code
+    ) -> np.ndarray:
+        """CPU-fallback fast path (models/host_kernel.py) — same contract as
+        the device kernel, operating on the host balance mirrors."""
+        from tigerbeetle_tpu.models import host_kernel
+
+        timestamp = int(ts[-1])
+        codes = host_kernel.validate(
+            events, ts, dr_slots, cr_slots, self.acc_ledger, host_code
+        )
+        ok = codes == 0
+        pend = (events["flags"].astype(np.uint32) & np.uint32(TransferFlags.PENDING)) != 0
+        overflow = host_kernel.post(
+            self._host_bal,
+            dr_slots, cr_slots,
+            events["amount_lo"].astype(np.uint64), events["amount_hi"].astype(np.uint64),
+            ok & pend, ok & ~pend,
+        )
+        if overflow:
+            self.stats["bail_batches"] += 1
+            return self._create_transfers_serial(events, timestamp)
+        self.stats["fast_batches"] += 1
         if np.any(ok):
             recs = events[ok].copy()
             recs["timestamp"] = ts[ok]

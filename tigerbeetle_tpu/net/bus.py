@@ -138,10 +138,20 @@ class ReplicaServer:
             if msg is None:
                 break
             h = msg.header
-            if h["command"] == Command.REQUEST or h["command"] == Command.PING_CLIENT:
-                if client_id is None and h["client"] != 0:
+            cmd = h["command"]
+            if cmd == Command.PING_CLIENT and h["client"] != 0:
+                # Explicit client hello: always (re)map — this connection IS
+                # the client, and must win over any stale/forwarded mapping.
+                client_id = h["client"]
+                self.client_conns[client_id] = conn
+                continue  # hello is transport-level, not for the replica
+            if cmd == Command.REQUEST:
+                # Map only direct client connections: a REQUEST arriving on
+                # an identified peer connection was *forwarded* by a backup
+                # and must not steal the client's reply route.
+                if peer_replica is None and client_id is None and h["client"] != 0:
                     client_id = h["client"]
-                    self.client_conns[client_id] = conn
+                    self.client_conns.setdefault(client_id, conn)
             elif peer_replica is None and h["replica"] != self.me:
                 peer_replica = h["replica"]
                 self.peer_conns.setdefault(peer_replica, conn)

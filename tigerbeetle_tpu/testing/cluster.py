@@ -24,14 +24,19 @@ from tigerbeetle_tpu.vsr.replica import Replica
 
 
 class MemSnapshotStore:
+    """Op-tagged snapshots; only synced entries survive a crash()."""
+
     def __init__(self) -> None:
-        self._blob: Optional[bytes] = None
+        self._blobs: Dict[int, bytes] = {}
 
-    def save(self, blob: bytes) -> None:
-        self._blob = blob
+    def save(self, op: int, blob: bytes) -> None:
+        self._blobs[op] = blob
 
-    def load(self) -> Optional[bytes]:
-        return self._blob
+    def load(self, op: int) -> Optional[bytes]:
+        return self._blobs.get(op)
+
+    def prune(self, keep_op: int) -> None:
+        self._blobs = {op: b for op, b in self._blobs.items() if op == keep_op}
 
 
 class PacketSimulator:

@@ -184,7 +184,10 @@ class Replica:
         self.commit_max = max(st.commit_max, st.op_checkpoint)
 
         if self.snapshot_store is not None and st.op_checkpoint > 0:
-            blob = self.snapshot_store.load()
+            # Load the snapshot for EXACTLY the superblock's checkpoint op —
+            # a newer snapshot may exist if we crashed between snapshot save
+            # and superblock write; it must be ignored (stale-future).
+            blob = self.snapshot_store.load(st.op_checkpoint)
             assert blob is not None, "superblock references a checkpoint; snapshot missing"
             self._load_snapshot(blob)
 
@@ -270,6 +273,8 @@ class Replica:
                 self.bus.send_to_replica(self.primary_index(self.view), msg)
             return
         h = msg.header
+        if not self._request_valid(h, msg.body):
+            return
         client = h["client"]
         sess = self.clients.get(client)
 
@@ -299,7 +304,36 @@ class Replica:
             if h["request"] == sess.request and sess.reply is not None:
                 self.bus.send_to_client(client, sess.reply)
             return
+        # Drop resends of requests still in flight (uncommitted in the
+        # pipeline or queued) — preparing them twice would execute twice.
+        for pending in self.pipeline:
+            ph = pending.message.header
+            if ph["client"] == client and ph["request"] >= h["request"]:
+                return
+        for queued in self.request_queue:
+            qh = queued.header
+            if qh["client"] == client and qh["request"] >= h["request"]:
+                return
         self._append_request(msg)
+
+    def _request_valid(self, h: Header, body: bytes) -> bool:
+        """Size/shape validation before any state changes (a malformed
+        request must never wedge the prepare path)."""
+        if hdr.HEADER_SIZE + len(body) > self.config.message_size_max:
+            return False
+        operation = h["operation"]
+        if operation >= 128:
+            ev_size = _event_dtype(operation).itemsize
+            if len(body) % ev_size != 0:
+                return False
+            if len(body) // ev_size > self.config.batch_max:
+                return False
+        elif operation == Operation.REGISTER:
+            if len(body) != 0:
+                return False
+        else:
+            return False
+        return True
 
     def _reply_cached(self, client: int, sess: ClientSession) -> None:
         if sess.reply is not None:
@@ -367,6 +401,8 @@ class Replica:
             if h["op"] <= self.op and self.journal.read_prepare(h["op"]) is None:
                 self.journal.write_prepare(msg)
                 self._commit_journal(self.commit_max)
+                if self.is_primary and self.op > self.commit_min:
+                    self._reproposal_pipeline(self.view)
             return
         if h["view"] > self.view:
             self._start_view_change(h["view"])  # catch up via view change
@@ -611,11 +647,25 @@ class Replica:
     def _reproposal_pipeline(self, v: int) -> None:
         """Re-propose uncommitted journal ops in the new view so they can
         collect prepare_ok quorums (reference primary repair after
-        start_view; replica.zig pipeline reconstruction)."""
+        start_view; replica.zig pipeline reconstruction). Re-entrant: called
+        again whenever a repaired prepare fills a gap."""
+        in_pipe = {e.message.header["op"] for e in self.pipeline}
         for op in range(self.commit_min + 1, self.op + 1):
+            if op in in_pipe:
+                continue
             msg = self.journal.read_prepare(op)
             if msg is None:
-                break  # will arrive via repair; re-proposed on a later pass
+                # Fetch the gap from every peer; on arrival the old-view
+                # repair path in on_prepare re-invokes this method.
+                rp = hdr.make(
+                    Command.REQUEST_PREPARE, self.cluster,
+                    view=v, op=op, replica=self.replica,
+                )
+                m = Message(rp).seal()
+                for r in range(self.replica_count):
+                    if r != self.replica:
+                        self.bus.send_to_replica(r, m)
+                break
             h = msg.header
             prev = self.journal.headers.get(self.journal.slot_for_op(op - 1))
             nh = hdr.make(
@@ -633,6 +683,7 @@ class Replica:
             for r in range(self.replica_count):
                 if r != self.replica:
                     self.bus.send_to_replica(r, prepare)
+        self.pipeline.sort(key=lambda e: e.message.header["op"])
 
     def on_start_view(self, msg: Message) -> None:
         h = msg.header
@@ -762,7 +813,7 @@ class Replica:
         if self.commit_min <= self.superblock.state.op_checkpoint:
             return
         if self.snapshot_store is not None:
-            self.snapshot_store.save(self._save_snapshot())
+            self.snapshot_store.save(self.commit_min, self._save_snapshot())
         st = self.superblock.state
         st.op_checkpoint = self.commit_min
         st.commit_min = self.commit_min
@@ -772,6 +823,9 @@ class Replica:
         st.prepare_timestamp = self.state_machine.prepare_timestamp
         st.commit_timestamp = self.state_machine.commit_timestamp
         self.superblock.checkpoint()
+        if self.snapshot_store is not None:
+            # Only after the superblock is durable may older snapshots go.
+            self.snapshot_store.prune(keep_op=self.commit_min)
         self.on_event("checkpoint", self)
 
     def _save_snapshot(self) -> bytes:
