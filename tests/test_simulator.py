@@ -1,0 +1,11 @@
+"""VOPR smoke: a handful of seeds must pass (randomized cluster + faults +
+auditor). The wider sweep runs out-of-band (python -m tigerbeetle_tpu.simulator)."""
+
+import pytest
+
+from tigerbeetle_tpu.simulator import EXIT_PASS, Simulator
+
+
+@pytest.mark.parametrize("seed", [1, 5, 7, 12, 14, 24])
+def test_vopr_seed(seed):
+    assert Simulator(seed, requests=25).run() == EXIT_PASS

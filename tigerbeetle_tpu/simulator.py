@@ -1,0 +1,197 @@
+"""The VOPR: randomized whole-cluster simulation under faults.
+
+The analog of /root/reference/src/simulator.zig + vopr.zig: from one seed,
+randomize cluster size, client count, network fault rates, crash/partition
+schedules; run the accounting workload; validate every reply against the
+serial-oracle auditor (testing/workload.py); then heal, drain, and check
+cross-replica state convergence. Failure taxonomy mirrors the reference
+(cluster.zig:35-40): exit 0 = pass, 1 = correctness, 2 = liveness,
+3 = crash (unhandled exception).
+
+Run: python -m tigerbeetle_tpu.simulator <seed> [--requests N] [--verbose]
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import sys
+
+from tigerbeetle_tpu.constants import TEST_MIN
+from tigerbeetle_tpu.testing.cluster import Cluster
+from tigerbeetle_tpu.testing.workload import Workload
+
+EXIT_PASS = 0
+EXIT_CORRECTNESS = 1
+EXIT_LIVENESS = 2
+EXIT_CRASH = 3
+
+
+class Simulator:
+    def __init__(self, seed: int, requests: int = 30, verbose: bool = False) -> None:
+        self.seed = seed
+        self.verbose = verbose
+        rng = random.Random(seed)
+        self.replica_count = rng.choice([1, 2, 3, 3, 5])
+        self.client_count = rng.choice([1, 1, 2])
+        loss = rng.choice([0.0, 0.01, 0.05])
+        self.requests_target = requests
+        self.cluster = Cluster(
+            replica_count=self.replica_count,
+            client_count=self.client_count,
+            config=TEST_MIN,
+            seed=seed,
+            loss=loss,
+        )
+        self.cluster.net.dup = rng.choice([0.0, 0.02])
+        self.workload = Workload(self.cluster, seed * 31 + 1)
+        self.rng = rng
+
+        # fault schedule: crash/restart windows and partitions
+        self.crash_at: dict[int, int] = {}  # tick -> replica
+        self.restart_at: dict[int, int] = {}
+        self.partition_at: dict[int, tuple] = {}
+        self.heal_at: set[int] = set()
+        if self.replica_count >= 3:
+            t = rng.randint(60, 250)
+            for _ in range(rng.randint(1, 3)):
+                victim = rng.randrange(self.replica_count)
+                down = rng.randint(400, 1500)
+                self.crash_at[t] = victim
+                self.restart_at[t + down] = victim
+                t += rng.randint(700, 2000)
+            if rng.random() < 0.5:
+                a, b = rng.sample(range(self.replica_count), 2)
+                pt = rng.randint(100, 1500)
+                self.partition_at[pt] = (("replica", a), ("replica", b))
+                self.heal_at.add(pt + rng.randint(300, 1200))
+        self.log = []
+
+    def run(self, tick_budget: int = 200_000) -> int:
+        cl = self.cluster
+        for c in cl.clients.values():
+            c.register()
+        down: set[int] = set()
+        tick = 0
+        last_progress = 0
+        last_done = 0
+        while self.workload.requests_done < self.requests_target:
+            tick += 1
+            if tick > tick_budget:
+                return self._fail_liveness(f"{self.workload.requests_done} of "
+                                           f"{self.requests_target} requests done")
+            if tick in self.crash_at:
+                victim = self.crash_at[tick]
+                live = self.replica_count - len(down)
+                if victim not in down and live - 1 > self.replica_count // 2:
+                    down.add(victim)
+                    cl.storages[victim].sync()  # clean crash; torn-write crashes are journal tests
+                    cl.crash_replica(victim)
+                    self.log.append((tick, f"crash replica {victim}"))
+            if tick in self.restart_at:
+                victim = self.restart_at[tick]
+                if victim in down:
+                    down.discard(victim)
+                    cl.restart_replica(victim)
+                    self.log.append((tick, f"restart replica {victim}"))
+            if tick in self.partition_at:
+                a, b = self.partition_at[tick]
+                cl.net.partition(a, b)
+                self.log.append((tick, f"partition {a} {b}"))
+            if tick in self.heal_at:
+                cl.net.heal()
+                self.log.append((tick, "heal"))
+            cl.step()
+            self.workload.tick()
+            if self.workload.requests_done > last_done:
+                last_done = self.workload.requests_done
+                last_progress = tick
+            if tick - last_progress > 60_000:
+                return self._fail_liveness("no progress for 60k ticks")
+
+        # Drain: heal everything, restart everyone; wait until every client
+        # is idle (outstanding replies resolved — the auditor needs them),
+        # the auditor has applied every committed op, and replicas converge.
+        cl.net.heal()
+        for victim in sorted(down):
+            cl.restart_replica(victim)
+        for _ in range(90_000):
+            cl.step()
+            live = [r for r in cl.replicas if r is not None]
+            target = max(r.commit_min for r in live)
+            clients_idle = all(c.idle for c in cl.clients.values())
+            if (
+                clients_idle
+                and all(r.commit_min >= target for r in live)
+                and self.workload.auditor._applied_op >= target
+            ):
+                break
+        else:
+            return self._fail_liveness(
+                f"drain incomplete: auditor at {self.workload.auditor._applied_op}, "
+                f"clients idle={[c.idle for c in cl.clients.values()]}"
+            )
+
+        # Checks: auditor + state convergence + balances vs the oracle.
+        if not self.workload.auditor.clean:
+            for f in self.workload.auditor.failures[:5]:
+                print(f"correctness: {f}", file=sys.stderr)
+            return EXIT_CORRECTNESS
+        compared = cl.check_state_convergence()
+        orc = self.workload.auditor.oracle
+        r0 = next(r for r in cl.replicas if r is not None)
+        if r0.commit_min == self.workload.auditor._applied_op:
+            for ident, acct in orc.accounts.items():
+                import numpy as np
+
+                out = r0.state_machine.lookup_accounts(
+                    np.array([ident & ((1 << 64) - 1)], dtype=np.uint64),
+                    np.array([ident >> 64], dtype=np.uint64),
+                )
+                if len(out) != 1:
+                    print(f"correctness: account {ident} missing", file=sys.stderr)
+                    return EXIT_CORRECTNESS
+                from tigerbeetle_tpu.models.oracle import account_from_numpy
+
+                got = account_from_numpy(out[0])
+                if got != acct:
+                    print(
+                        f"correctness: account {ident} diverges:\n"
+                        f"  cluster {got}\n  oracle  {acct}",
+                        file=sys.stderr,
+                    )
+                    return EXIT_CORRECTNESS
+        if self.verbose:
+            print(
+                f"seed {self.seed}: PASS — replicas={self.replica_count} "
+                f"clients={self.client_count} loss={self.cluster.net.loss} "
+                f"requests={self.workload.requests_done} "
+                f"ops_checked={self.workload.auditor.checked_ops} "
+                f"state_ops={compared} faults={self.log}"
+            )
+        return EXIT_PASS
+
+    def _fail_liveness(self, why: str) -> int:
+        live = [(r.replica, r.status, r.view, r.commit_min)
+                for r in self.cluster.replicas if r is not None]
+        print(f"liveness: {why}; replicas={live} faults={self.log}", file=sys.stderr)
+        return EXIT_LIVENESS
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("seed", type=int)
+    p.add_argument("--requests", type=int, default=30)
+    p.add_argument("--verbose", action="store_true")
+    args = p.parse_args(argv)
+    try:
+        return Simulator(args.seed, requests=args.requests, verbose=True).run()
+    except Exception:  # noqa: BLE001 — VOPR crash taxonomy
+        import traceback
+
+        traceback.print_exc()
+        return EXIT_CRASH
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,6 +42,8 @@ class Command:
     REQUEST_REPLY = 16
     HEADERS = 17
     EVICTION = 18
+    REQUEST_SYNC_CHECKPOINT = 19
+    SYNC_CHECKPOINT = 20
     NAMES = {}
 
 

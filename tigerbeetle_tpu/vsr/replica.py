@@ -130,9 +130,15 @@ class Replica:
         self.last_heartbeat_tick = 0
         self.last_commit_sent_tick = 0
         self.last_repair_tick = 0
+        self.recovering_since = 0
+        # replica → (view, is_normal) pongs collected while recovering.
+        self._recovery_pongs: Dict[int, tuple] = {}
 
-        # commit-number → checksum chain, used by the state checker.
+        # commit-number → checksum chain, used by the state checker. Ops at
+        # or below checksum_floor were recovered from a checkpoint snapshot
+        # and have no individually recorded checksum.
         self.commit_checksums: Dict[int, int] = {}
+        self.checksum_floor = 0
 
     # ------------------------------------------------------------------
 
@@ -182,6 +188,7 @@ class Replica:
         self.log_view = st.log_view
         self.commit_min = st.op_checkpoint
         self.commit_max = max(st.commit_max, st.op_checkpoint)
+        self.checksum_floor = st.op_checkpoint
 
         if self.snapshot_store is not None and st.op_checkpoint > 0:
             # Load the snapshot for EXACTLY the superblock's checkpoint op —
@@ -212,7 +219,15 @@ class Replica:
                 self._execute(msg, replay=True)
                 self.commit_min = op
             self.commit_max = max(self.commit_max, self.commit_min)
-        self.status = STATUS_NORMAL
+        if self.replica_count == 1:
+            self.status = STATUS_NORMAL
+        else:
+            # A restarted replica must learn the cluster's current view
+            # before serving (reference .recovering, replica.zig:36-50):
+            # acting as primary of a stale view would evict live clients
+            # and serve stale state.
+            self.status = STATUS_RECOVERING
+            self.recovering_since = self.tick_count
         self.on_event("open", self)
 
     # ------------------------------------------------------------------
@@ -232,6 +247,36 @@ class Replica:
         elif self.status == STATUS_VIEW_CHANGE:
             if self.tick_count - self.last_heartbeat_tick >= VIEW_CHANGE_TIMEOUT:
                 self._start_view_change(self.view + 1)
+        elif self.status == STATUS_RECOVERING:
+            self._recovering_tick()
+
+    RECOVERING_PING_INTERVAL = 20
+    RECOVERING_ELECTION_WAIT = 120
+
+    def _recovering_tick(self) -> None:
+        if self.tick_count % self.RECOVERING_PING_INTERVAL == 0:
+            ping = hdr.make(
+                Command.PING, self.cluster,
+                view=self.view, replica=self.replica,
+            )
+            m = Message(ping).seal()
+            for r in range(self.replica_count):
+                if r != self.replica:
+                    self.bus.send_to_replica(r, m)
+        normal_views = [v for v, ok in self._recovery_pongs.values() if ok]
+        if normal_views:
+            # An active view exists — adopt it via request_start_view.
+            self._catch_up(max(max(normal_views), self.view))
+            return
+        # Nobody is normal (whole-cluster restart): once a view-change
+        # quorum of equally-lost replicas is visible, elect a fresh view.
+        waited = self.tick_count - self.recovering_since
+        if (
+            waited >= self.RECOVERING_ELECTION_WAIT
+            and len(self._recovery_pongs) + 1 >= self.quorum_view_change
+        ):
+            views = [v for v, _ in self._recovery_pongs.values()]
+            self._start_view_change(max([self.view, *views]) + 1)
 
     # ------------------------------------------------------------------
     # message dispatch
@@ -251,9 +296,11 @@ class Replica:
             Command.START_VIEW_CHANGE: self.on_start_view_change,
             Command.DO_VIEW_CHANGE: self.on_do_view_change,
             Command.START_VIEW: self.on_start_view,
+            Command.REQUEST_START_VIEW: self.on_request_start_view,
             Command.REQUEST_PREPARE: self.on_request_prepare,
+            Command.SYNC_CHECKPOINT: self.on_sync_checkpoint,
             Command.PING: self.on_ping,
-            Command.PONG: lambda m: None,
+            Command.PONG: self.on_pong,
         }.get(cmd)
         if handler is not None:
             handler(msg)
@@ -262,9 +309,16 @@ class Replica:
 
     def on_ping(self, msg: Message) -> None:
         pong = hdr.make(
-            Command.PONG, self.cluster, replica=self.replica, view=self.view
+            Command.PONG, self.cluster, replica=self.replica, view=self.view,
+            request=1 if self.status == STATUS_NORMAL else 0,
         )
         self.bus.send_to_replica(msg.header["replica"], Message(pong).seal())
+
+    def on_pong(self, msg: Message) -> None:
+        if self.status != STATUS_RECOVERING:
+            return
+        h = msg.header
+        self._recovery_pongs[h["replica"]] = (h["view"], h["request"] == 1)
 
     def on_request(self, msg: Message) -> None:
         if not self.is_primary:
@@ -405,7 +459,7 @@ class Replica:
                     self._reproposal_pipeline(self.view)
             return
         if h["view"] > self.view:
-            self._start_view_change(h["view"])  # catch up via view change
+            self._catch_up(h["view"])  # lagging: ask the new primary for the view
             return
         self.last_heartbeat_tick = self.tick_count
         if h["op"] <= self.op:
@@ -483,10 +537,36 @@ class Replica:
 
     def on_commit(self, msg: Message) -> None:
         h = msg.header
+        if h["view"] > self.view:
+            # A commit heartbeat from a newer view: we missed a view change
+            # (crashed/partitioned through it) — catch up via start_view.
+            self._catch_up(h["view"])
+            return
         if self.status != STATUS_NORMAL or h["view"] != self.view or self.is_primary:
             return
         self.last_heartbeat_tick = self.tick_count
         self._commit_journal(h["commit"])
+
+    def _catch_up(self, view: int) -> None:
+        """Request the current view state from the newer view's primary
+        (reference request_start_view; replica.zig on_request_start_view).
+        Non-disruptive: does not start a view change of its own."""
+        self.last_heartbeat_tick = self.tick_count
+        rsv = hdr.make(
+            Command.REQUEST_START_VIEW, self.cluster,
+            view=view, replica=self.replica,
+        )
+        self.bus.send_to_replica(self.primary_index(view), Message(rsv).seal())
+
+    def on_request_start_view(self, msg: Message) -> None:
+        if not self.is_primary or msg.header["view"] != self.view:
+            return
+        sv = hdr.make(
+            Command.START_VIEW, self.cluster,
+            view=self.view, replica=self.replica, op=self.op, commit=self.commit_min,
+        )
+        body = b"".join(h.to_bytes() for h in self._recent_headers())
+        self.bus.send_to_replica(msg.header["replica"], Message(sv, body).seal())
 
     def _commit_journal(self, commit_target: int) -> None:
         self.commit_max = max(self.commit_max, commit_target)
@@ -524,9 +604,52 @@ class Replica:
             want += 1
 
     def on_request_prepare(self, msg: Message) -> None:
-        m = self.journal.read_prepare(msg.header["op"])
+        op = msg.header["op"]
+        m = self.journal.read_prepare(op)
         if m is not None:
             self.bus.send_to_replica(msg.header["replica"], m)
+            return
+        # The requested op predates our checkpoint (WAL ring wrapped): the
+        # requester is too far behind for WAL repair and must state-sync
+        # (reference docs/internals/sync.md; replica.zig:7765+). Send our
+        # checkpoint snapshot. TODO: chunk via grid blocks for large states.
+        st = self.superblock.state
+        if op <= st.op_checkpoint and self.snapshot_store is not None:
+            blob = self.snapshot_store.load(st.op_checkpoint)
+            if blob is not None:
+                sc = hdr.make(
+                    Command.SYNC_CHECKPOINT, self.cluster,
+                    view=self.view, replica=self.replica,
+                    op=st.op_checkpoint, commit=self.commit_min,
+                    checkpoint_op=st.op_checkpoint,
+                )
+                self.bus.send_to_replica(
+                    msg.header["replica"], Message(sc, blob).seal()
+                )
+
+    def on_sync_checkpoint(self, msg: Message) -> None:
+        """Install a peer's checkpoint: reset the state machine to the
+        snapshot and resume WAL repair from there."""
+        h = msg.header
+        sync_op = h["checkpoint_op"]
+        if sync_op <= self.commit_min or sync_op <= self.superblock.state.op_checkpoint:
+            return
+        self.state_machine = StateMachine(self.config, backend=self.sm_backend)
+        self._load_snapshot(msg.body)
+        self.commit_min = sync_op
+        self.checksum_floor = sync_op
+        self.op = max(self.op, sync_op)
+        st = self.superblock.state
+        st.op_checkpoint = sync_op
+        st.commit_min = sync_op
+        st.commit_max = max(st.commit_max, sync_op)
+        if self.snapshot_store is not None:
+            self.snapshot_store.save(sync_op, msg.body)
+        self.superblock.checkpoint()
+        if self.snapshot_store is not None:
+            self.snapshot_store.prune(keep_op=sync_op)
+        self.on_event("sync", self)
+        self._commit_journal(self.commit_max)
 
     # --- view change ----------------------------------------------------
 
@@ -554,7 +677,7 @@ class Replica:
         if v < self.view:
             return
         self.start_view_change_from.setdefault(v, set()).add(msg.header["replica"])
-        if v > self.view and self.status == STATUS_NORMAL:
+        if v > self.view and self.status in (STATUS_NORMAL, STATUS_RECOVERING):
             if len(self.start_view_change_from[v]) >= self.quorum_view_change - 1:
                 self._start_view_change(v)
                 return
@@ -693,6 +816,7 @@ class Replica:
         self.view = v
         self.log_view = v
         self.status = STATUS_NORMAL
+        self._recovery_pongs = {}
         self.last_heartbeat_tick = self.tick_count
         self.op = max(self.op, h["op"])
         self._persist_view()
