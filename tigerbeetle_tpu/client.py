@@ -48,6 +48,7 @@ class Client:
         self._socks: dict[int, socket.socket] = {}
         self._bufs: dict[int, bytes] = {}
         self._target = 0
+        self.registered = False
         self.register()
 
     # --- wire -----------------------------------------------------------
@@ -154,6 +155,9 @@ class Client:
                         for reply in self._pump(r):
                             h = reply.header
                             if h["command"] == Command.EVICTION:
+                                # The session is gone server-side; allow a
+                                # fresh register() to establish a new one.
+                                self.registered = False
                                 raise SessionEvicted("session evicted by cluster")
                             if (
                                 h["command"] == Command.REPLY
@@ -168,7 +172,13 @@ class Client:
     # --- session --------------------------------------------------------
 
     def register(self) -> None:
+        """Idempotent: __init__ registers; a repeat call is a no-op (the
+        cluster would only resend the cached register reply, whose request
+        number can never match a fresh one)."""
+        if self.registered:
+            return
         self._roundtrip(Operation.REGISTER, b"")
+        self.registered = True
 
     def close(self) -> None:
         for s in self._socks.values():

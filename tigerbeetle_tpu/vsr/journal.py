@@ -33,11 +33,25 @@ class Journal:
 
     # --- write ----------------------------------------------------------
 
+    def can_write(self, op: int) -> bool:
+        """A slot may only be (over)written by the same or a newer op.
+
+        Guards the ring-wrap hazard (reference journal slot reuse asserts):
+        a stale re-delivered prepare or late repair response for op k must
+        never clobber slot k % slot_count once it holds op k + slot_count.
+        """
+        h = self.headers.get(self.slot_for_op(op))
+        return h is None or h["op"] <= op
+
     def write_prepare(self, message: Message, sync: bool = True) -> None:
         """Durably store a prepare in its slot (body ring then header ring;
         reference replica.zig:8454 writes sectors of both rings)."""
         assert message.header["command"] == Command.PREPARE
         op = message.header["op"]
+        assert self.can_write(op), (
+            f"slot {self.slot_for_op(op)} holds newer op "
+            f"{self.headers[self.slot_for_op(op)]['op']} > {op}"
+        )
         slot = self.slot_for_op(op)
         raw = message.to_bytes()
         assert len(raw) <= self.message_size_max
@@ -52,6 +66,45 @@ class Journal:
         self.headers[slot] = message.header.copy()
         self.dirty.discard(slot)
         self.faulty.discard(slot)
+
+    def zero_slot(self, slot: int, sync: bool = True) -> None:
+        """Erase a slot on disk (both rings) so a truncated op can never be
+        resurrected by recovery after a restart."""
+        self.storage.write(
+            self.zone.wal_headers_offset + slot * HEADER_SIZE, b"\x00" * HEADER_SIZE
+        )
+        # Zeroing the body's leading header bytes invalidates its checksum,
+        # which is all recovery needs to classify the slot as fresh.
+        self.storage.write(
+            self.zone.wal_prepares_offset + slot * self.message_size_max,
+            b"\x00" * HEADER_SIZE,
+        )
+        if sync:
+            self.storage.sync()
+        self.headers.pop(slot, None)
+        self.dirty.discard(slot)
+        self.faulty.discard(slot)
+
+    def truncate(self, op_max: int) -> None:
+        """Drop every journal entry above op_max (view-change truncation of
+        uncommitted ops not in the winning log — reference DVCQuorum nacks)."""
+        victims = [s for s, h in self.headers.items() if h["op"] > op_max]
+        for slot in victims:
+            self.zero_slot(slot, sync=False)
+        if victims:
+            self.storage.sync()
+
+    def flush_dirty(self) -> None:
+        """Rewrite header-ring slots whose redundant header was torn but
+        whose body survived (recovery classified them `dirty`)."""
+        for slot in sorted(self.dirty):
+            self.storage.write(
+                self.zone.wal_headers_offset + slot * HEADER_SIZE,
+                self.headers[slot].to_bytes(),
+            )
+        if self.dirty:
+            self.storage.sync()
+        self.dirty.clear()
 
     # --- read -----------------------------------------------------------
 

@@ -51,6 +51,13 @@ VIEW_CHANGE_TIMEOUT = 300
 REPAIR_TIMEOUT = 20
 
 
+def _parse_headers(body: bytes) -> List[Header]:
+    return [
+        Header.from_bytes(body[i * hdr.HEADER_SIZE : (i + 1) * hdr.HEADER_SIZE])
+        for i in range(len(body) // hdr.HEADER_SIZE)
+    ]
+
+
 def _event_dtype(operation: int) -> np.dtype:
     if operation == Operation.CREATE_ACCOUNTS:
         return types.ACCOUNT_DTYPE
@@ -125,6 +132,13 @@ class Replica:
         self.start_view_change_from: Dict[int, set[int]] = {}  # view -> replicas
         self.do_view_change_from: Dict[int, Dict[int, Message]] = {}
         self._dvc_sent_for_view = -1
+        # op → winning Header: the authoritative prepare content this replica
+        # must hold at that op, installed from winning DVC / SV / HEADERS
+        # bodies. A local prepare whose body differs is stale and must be
+        # repaired before it may be re-proposed, committed, or served to
+        # peers. Replaced wholesale at each view change; entries are popped
+        # as their ops are repaired or committed.
+        self.repair_target: Dict[int, Header] = {}
 
         self.tick_count = 0
         self.last_heartbeat_tick = 0
@@ -199,6 +213,7 @@ class Replica:
             self._load_snapshot(blob)
 
         self.journal.recover(self.cluster)
+        self.journal.flush_dirty()
         self.op = max(self.journal.highest_op(), st.op_checkpoint)
 
         # Re-execute contiguous committed prepares beyond the checkpoint.
@@ -298,6 +313,8 @@ class Replica:
             Command.START_VIEW: self.on_start_view,
             Command.REQUEST_START_VIEW: self.on_request_start_view,
             Command.REQUEST_PREPARE: self.on_request_prepare,
+            Command.REQUEST_HEADERS: self.on_request_headers,
+            Command.HEADERS: self.on_headers,
             Command.SYNC_CHECKPOINT: self.on_sync_checkpoint,
             Command.PING: self.on_ping,
             Command.PONG: self.on_pong,
@@ -376,7 +393,12 @@ class Replica:
         if hdr.HEADER_SIZE + len(body) > self.config.message_size_max:
             return False
         operation = h["operation"]
-        if operation >= 128:
+        if operation in (Operation.GET_ACCOUNT_TRANSFERS, Operation.GET_ACCOUNT_HISTORY):
+            # Exactly one filter record — a zero-event body would otherwise
+            # fault every replica at commit (client-triggerable poison pill).
+            if len(body) != types.ACCOUNT_FILTER_DTYPE.itemsize:
+                return False
+        elif operation >= 128:
             ev_size = _event_dtype(operation).itemsize
             if len(body) % ev_size != 0:
                 return False
@@ -448,38 +470,53 @@ class Replica:
         h = msg.header
         if self.status != STATUS_NORMAL:
             return
+        op = h["op"]
+        if op <= self.superblock.state.op_checkpoint:
+            return  # predates the durable checkpoint; never rewrite history
         if h["view"] < self.view:
             # A repair response: prepares keep their original view. Accept
-            # into the journal if the slot is missing, but never prepare_ok
-            # an old view (reference on_repair, replica.zig:1646).
-            if h["op"] <= self.op and self.journal.read_prepare(h["op"]) is None:
+            # into the journal if the slot is missing or holds content the
+            # winning log rejected, but never prepare_ok an old view
+            # (reference on_repair, replica.zig:1646).
+            if op > self.op or not self.journal.can_write(op):
+                return
+            target = self.repair_target.get(op)
+            if target is not None and not self._content_eq(h, target):
+                return  # not the content the winning log requires
+            if not self._journal_has_target(op) or self.journal.read_prepare(op) is None:
+                # Hole, torn body, or stale content: install the repair.
                 self.journal.write_prepare(msg)
-                self._commit_journal(self.commit_max)
-                if self.is_primary and self.op > self.commit_min:
-                    self._reproposal_pipeline(self.view)
+            self.repair_target.pop(op, None)
+            self._commit_journal(self.commit_max)
+            if self.is_primary and self.op > self.commit_min:
+                self._reproposal_pipeline(self.view)
             return
         if h["view"] > self.view:
             self._catch_up(h["view"])  # lagging: ask the new primary for the view
             return
         self.last_heartbeat_tick = self.tick_count
-        if h["op"] <= self.op:
-            existing = self.journal.read_prepare(h["op"])
+        if op <= self.op:
+            existing = self.journal.read_prepare(op)
             if existing is not None and existing.header["checksum"] == h["checksum"]:
+                self.repair_target.pop(op, None)
                 self._send_prepare_ok(h)
                 self._commit_journal(h["commit"])
                 return
-            if existing is None or h["view"] >= existing.header["view"]:
+            if (existing is None or h["view"] >= existing.header["view"]) and (
+                self.journal.can_write(op)
+            ):
                 # Re-proposed in a newer view (post view-change): overwrite.
                 self.journal.write_prepare(msg)
+                self.repair_target.pop(op, None)
                 self._send_prepare_ok(h)
                 self._commit_journal(h["commit"])
             return
-        if h["op"] != self.op + 1:
+        if op != self.op + 1:
             # Gap: remember commit target; repair will fetch missing ops.
             self.commit_max = max(self.commit_max, h["commit"])
-            self._repair_gaps(target=h["op"])
+            self._repair_gaps(target=op)
             return
-        self.op = h["op"]
+        self.op = op
         self.journal.write_prepare(msg)
         self._send_prepare_ok(h)
         self._commit_journal(h["commit"])
@@ -565,47 +602,131 @@ class Replica:
             Command.START_VIEW, self.cluster,
             view=self.view, replica=self.replica, op=self.op, commit=self.commit_min,
         )
-        body = b"".join(h.to_bytes() for h in self._recent_headers())
+        body = b"".join(h.to_bytes() for h in self._sv_body_headers())
         self.bus.send_to_replica(msg.header["replica"], Message(sv, body).seal())
 
     def _commit_journal(self, commit_target: int) -> None:
         self.commit_max = max(self.commit_max, commit_target)
         while self.commit_min < self.commit_max:
-            msg = self.journal.read_prepare(self.commit_min + 1)
+            op = self.commit_min + 1
+            msg = self.journal.read_prepare(op) if self._journal_has_target(op) else None
             if msg is None:
-                self._repair_gaps(target=self.commit_min + 1)
+                self._repair_gaps(target=op)
                 break
             self._execute(msg)
             self.commit_min += 1
+            self.repair_target.pop(op, None)
             self._maybe_checkpoint()
         if self.is_primary and self.pipeline:
             self._check_pipeline_quorum()
 
     # --- repair ---------------------------------------------------------
 
+    def _repair_peer(self) -> int:
+        peer = self.primary_index(self.view)
+        if peer == self.replica:
+            peer = (self.replica + 1) % self.replica_count
+        return peer
+
     def _repair_gaps(self, target: Optional[int] = None) -> None:
         if self.tick_count - self.last_repair_tick < REPAIR_TIMEOUT and target is None:
             return
         self.last_repair_tick = self.tick_count
-        want = self.commit_min + 1
+        peer = self._repair_peer()
         limit = target if target is not None else self.commit_max
-        count = 0
-        while want <= limit and count < 8:
-            if self.journal.read_prepare(want) is None:
-                rp = hdr.make(
-                    Command.REQUEST_PREPARE, self.cluster,
-                    view=self.view, op=want, replica=self.replica,
-                )
-                peer = self.primary_index(self.view)
-                if peer == self.replica:
-                    peer = (self.replica + 1) % self.replica_count
-                self.bus.send_to_replica(peer, Message(rp).seal())
-                count += 1
-            want += 1
+        # Ops needing a prepare: journal holes up to the commit target,
+        # recovery-classified faulty slots (torn bodies), and view-change
+        # repair targets whose content hasn't arrived yet. Presence checks
+        # go through the header map — no disk reads in this scan.
+        wants: set[int] = set()
+        for want in range(self.commit_min + 1, limit + 1):
+            if not self._journal_has_target(want):
+                wants.add(want)
+        for slot in self.journal.faulty:
+            h = self.journal.headers.get(slot)
+            if h is not None and h["op"] > self.commit_min:
+                wants.add(h["op"])
+        for op in self.repair_target:
+            if op > self.commit_min and not self._journal_has_target(op):
+                wants.add(op)
+        for want in sorted(wants)[:8]:
+            rp = hdr.make(
+                Command.REQUEST_PREPARE, self.cluster,
+                view=self.view, op=want, replica=self.replica,
+            )
+            self.bus.send_to_replica(peer, Message(rp).seal())
+        # Holes beyond the commit window whose headers we've never seen:
+        # fetch the headers first (reference request_headers,
+        # replica.zig:2131) so their content becomes a repair target.
+        if self.op > limit and any(
+            not self._journal_has_op(o) for o in range(limit + 1, self.op + 1)
+        ):
+            rh = hdr.make(
+                Command.REQUEST_HEADERS, self.cluster,
+                view=self.view, replica=self.replica,
+                commit=limit + 1, op=self.op,
+            )
+            self.bus.send_to_replica(peer, Message(rh).seal())
+
+    def _journal_has_target(self, op: int) -> bool:
+        """Is the journal's content at op trustworthy: present, not torn,
+        and (when a winning-log target exists) matching it?"""
+        if not self._journal_has_op(op):
+            return False
+        target = self.repair_target.get(op)
+        if target is None:
+            return True
+        return self._journal_matches(op, target)
+
+    def on_request_headers(self, msg: Message) -> None:
+        """Serve journal headers in [commit, op] (reference on_request_headers,
+        replica.zig:2131)."""
+        op_min = msg.header["commit"]
+        op_max = min(msg.header["op"], op_min + 64)
+        out = []
+        for op in range(op_min, op_max + 1):
+            # Only advertise content we can actually serve: not torn
+            # (faulty) and not itself pending winning-log repair.
+            if self._journal_has_target(op):
+                out.append(self.journal.headers[self.journal.slot_for_op(op)])
+        if not out:
+            return
+        resp = hdr.make(
+            Command.HEADERS, self.cluster, view=self.view, replica=self.replica,
+        )
+        body = b"".join(h.to_bytes() for h in out)
+        self.bus.send_to_replica(msg.header["replica"], Message(resp, body).seal())
+
+    def on_headers(self, msg: Message) -> None:
+        """Fill journal HOLES from received headers and fetch their prepares
+        (reference on_headers → repair). Unlike SV/DVC bodies, HEADERS are
+        not quorum-backed: a stale or delayed response must never override
+        existing content or an installed winning-log target, so only ops we
+        hold nothing for are accepted, and only in the current view.
+        """
+        if self.status != STATUS_NORMAL or msg.header["view"] != self.view:
+            return
+        if self.is_primary:
+            return  # the primary's log/targets are already authoritative
+        sender = msg.header["replica"]
+        for h in _parse_headers(msg.body):
+            op = h["op"]
+            if op <= self.commit_min or op > self.op:
+                continue
+            if self._journal_has_op(op) or op in self.repair_target:
+                continue
+            self.repair_target[op] = h
+            rp = hdr.make(
+                Command.REQUEST_PREPARE, self.cluster,
+                view=self.view, op=op, replica=self.replica,
+            )
+            self.bus.send_to_replica(sender, Message(rp).seal())
 
     def on_request_prepare(self, msg: Message) -> None:
         op = msg.header["op"]
-        m = self.journal.read_prepare(op)
+        # Never serve content that is itself pending winning-log repair —
+        # propagating a stale prepare could commit divergent state remotely.
+        m = self.journal.read_prepare(op) if self._journal_has_target(op) else None
         if m is not None:
             self.bus.send_to_replica(msg.header["replica"], m)
             return
@@ -635,6 +756,9 @@ class Replica:
         if sync_op <= self.commit_min or sync_op <= self.superblock.state.op_checkpoint:
             return
         self.state_machine = StateMachine(self.config, backend=self.sm_backend)
+        # The client table is replicated state — it must exactly match the
+        # installed checkpoint, so sessions from before the sync are dropped.
+        self.clients = {}
         self._load_snapshot(msg.body)
         self.commit_min = sync_op
         self.checksum_floor = sync_op
@@ -661,6 +785,11 @@ class Replica:
         self.status = STATUS_VIEW_CHANGE
         self.view = max(self.view, new_view)
         self.last_heartbeat_tick = self.tick_count
+        # The view promise must be durable BEFORE any SVC/DVC leaves this
+        # replica (reference view_durable): a replica that votes, crashes,
+        # and restarts with the older view could otherwise ack prepares in
+        # a view it promised to abandon, breaking quorum intersection.
+        self._persist_view()
         svc = hdr.make(
             Command.START_VIEW_CHANGE, self.cluster,
             view=new_view, replica=self.replica,
@@ -713,6 +842,21 @@ class Replica:
                 out.append(h)
         return out
 
+    def _sv_body_headers(self) -> List[Header]:
+        """Headers describing the WINNING log for a START_VIEW body: where a
+        repair target exists the local journal is stale, so the target
+        header is authoritative; elsewhere the journal entry is."""
+        out = []
+        for op in range(max(1, self.op - 32), self.op + 1):
+            target = self.repair_target.get(op)
+            if target is not None:
+                out.append(target)
+                continue
+            h = self.journal.headers.get(self.journal.slot_for_op(op))
+            if h is not None and h["op"] == op:
+                out.append(h)
+        return out
+
     def on_do_view_change(self, msg: Message) -> None:
         v = msg.header["view"]
         if v < self.view or self.primary_index(v) != self.replica:
@@ -726,27 +870,49 @@ class Replica:
         if self.status != STATUS_VIEW_CHANGE or self.view != v:
             return
 
-        # Pick the log with the highest (log_view, op) — reference DVCQuorum.
-        best = max(
-            dvcs.values(),
-            key=lambda m: (m.header["timestamp"], m.header["op"]),  # timestamp=log_view
-        )
-        new_op = best.header["op"]
+        # Reference DVCQuorum: the winning log is defined by the DVCs with
+        # the highest log_view (carried in `timestamp`); its length is their
+        # max op. Everything above that op — including this replica's own
+        # surviving journal tail from an older log_view — is uncommitted by
+        # definition and must be truncated, or a stale divergent entry could
+        # be re-proposed and commit different content than a later view did.
+        log_view_max = max(m.header["timestamp"] for m in dvcs.values())
+        candidates = [
+            m for m in dvcs.values() if m.header["timestamp"] == log_view_max
+        ]
+        new_op = max(m.header["op"] for m in candidates)
         new_commit = max(m.header["commit"] for m in dvcs.values())
 
-        # Install headers from the winning DVC body; fetch missing prepares.
-        body = best.body
-        for i in range(len(body) // hdr.HEADER_SIZE):
-            h = Header.from_bytes(body[i * hdr.HEADER_SIZE : (i + 1) * hdr.HEADER_SIZE])
-            if h["op"] > self.op and self.journal.read_prepare(h["op"]) is None:
-                rp = hdr.make(
-                    Command.REQUEST_PREPARE, self.cluster,
-                    view=v, op=h["op"], replica=self.replica,
-                )
-                self.bus.send_to_replica(best.header["replica"], Message(rp).seal())
+        # Merge the candidates' header windows. Within one log_view every op
+        # slot was assigned exactly once by that view's primary, so shared
+        # ops agree on content; any candidate's copy is authoritative.
+        merged: Dict[int, Header] = {}
+        senders: Dict[int, int] = {}
+        for m in candidates:
+            for h in _parse_headers(m.body):
+                merged[h["op"]] = h
+                senders[h["op"]] = m.header["replica"]
 
-        self.op = max(self.op, new_op)
+        if self.op > new_op:
+            self.journal.truncate(new_op)
+        self.op = new_op
         self.commit_max = max(self.commit_max, new_commit)
+
+        # Install the winning content as repair targets: local prepares whose
+        # body differs are stale and may not be re-proposed until repaired.
+        # Wholesale replacement — targets from earlier views are obsolete.
+        self.repair_target = {}
+        for op, h in merged.items():
+            if op <= self.commit_min or op > new_op:
+                continue
+            if not self._journal_matches(op, h):
+                self.repair_target[op] = h
+                if senders[op] != self.replica:
+                    rp = hdr.make(
+                        Command.REQUEST_PREPARE, self.cluster,
+                        view=v, op=op, replica=self.replica,
+                    )
+                    self.bus.send_to_replica(senders[op], Message(rp).seal())
 
         # Become primary of the new view.
         self.status = STATUS_NORMAL
@@ -758,14 +924,38 @@ class Replica:
             Command.START_VIEW, self.cluster,
             view=v, replica=self.replica, op=self.op, commit=self.commit_min,
         )
-        body = b"".join(h.to_bytes() for h in self._recent_headers())
-        m = Message(sv, body).seal()
+        m = Message(sv, b"".join(h.to_bytes() for h in self._sv_body_headers())).seal()
         for r in range(self.replica_count):
             if r != self.replica:
                 self.bus.send_to_replica(r, m)
         self._commit_journal(self.commit_max)
         self._reproposal_pipeline(v)
         self.on_event("view_change", self)
+
+    @staticmethod
+    def _content_eq(a: Header, b: Header) -> bool:
+        """Logical prepare identity: seal checksums differ across re-proposal
+        views; what must match is (checksum_body, timestamp)."""
+        return (
+            a["checksum_body"] == b["checksum_body"]
+            and a["timestamp"] == b["timestamp"]
+        )
+
+    def _journal_has_op(self, op: int) -> bool:
+        """Header-ring presence check (no disk IO): the slot holds this op
+        and its body is not recovery-classified torn."""
+        slot = self.journal.slot_for_op(op)
+        local = self.journal.headers.get(slot)
+        return (
+            local is not None and local["op"] == op and slot not in self.journal.faulty
+        )
+
+    def _journal_matches(self, op: int, h: Header) -> bool:
+        """Does the local journal hold a prepare with this op and body?"""
+        local = self.journal.headers.get(self.journal.slot_for_op(op))
+        return (
+            local is not None and local["op"] == op and self._content_eq(local, h)
+        )
 
     def _reproposal_pipeline(self, v: int) -> None:
         """Re-propose uncommitted journal ops in the new view so they can
@@ -776,7 +966,7 @@ class Replica:
         for op in range(self.commit_min + 1, self.op + 1):
             if op in in_pipe:
                 continue
-            msg = self.journal.read_prepare(op)
+            msg = self.journal.read_prepare(op) if self._journal_has_target(op) else None
             if msg is None:
                 # Fetch the gap from every peer; on arrival the old-view
                 # repair path in on_prepare re-invokes this method.
@@ -789,6 +979,7 @@ class Replica:
                     if r != self.replica:
                         self.bus.send_to_replica(r, m)
                 break
+            self.repair_target.pop(op, None)
             h = msg.header
             prev = self.journal.headers.get(self.journal.slot_for_op(op - 1))
             nh = hdr.make(
@@ -818,13 +1009,35 @@ class Replica:
         self.status = STATUS_NORMAL
         self._recovery_pongs = {}
         self.last_heartbeat_tick = self.tick_count
-        self.op = max(self.op, h["op"])
+
+        # Adopt the new view's log exactly: truncate our uncommitted tail
+        # beyond it, then install the body headers as repair targets so any
+        # stale local prepare is replaced before it can commit.
+        new_op = h["op"]
+        if self.op > new_op:
+            self.journal.truncate(new_op)
+        self.op = max(new_op, self.commit_min)
+        primary = h["replica"]
+        self.repair_target = {}
+        for sh in _parse_headers(msg.body):
+            op = sh["op"]
+            if op <= self.commit_min or op > new_op:
+                continue
+            if not self._journal_matches(op, sh):
+                self.repair_target[op] = sh
+                rp = hdr.make(
+                    Command.REQUEST_PREPARE, self.cluster,
+                    view=v, op=op, replica=self.replica,
+                )
+                self.bus.send_to_replica(primary, Message(rp).seal())
         self._persist_view()
         self._commit_journal(h["commit"])
         self.on_event("view_change", self)
 
     def _persist_view(self) -> None:
         st = self.superblock.state
+        if st.view == self.view and st.log_view == self.log_view:
+            return
         st.view = self.view
         st.log_view = self.log_view
         self.superblock.checkpoint()
@@ -861,9 +1074,15 @@ class Replica:
                 recs = sm.lookup_transfers(events["lo"], events["hi"])
                 results = recs.tobytes()
             elif operation == Operation.GET_ACCOUNT_TRANSFERS:
-                results = self._get_account_transfers(events[0]).tobytes()
+                # Defense in depth vs malformed committed bodies: a commit
+                # must never raise, or the whole cluster crash-loops.
+                results = (
+                    self._get_account_transfers(events[0]).tobytes() if len(events) else b""
+                )
             elif operation == Operation.GET_ACCOUNT_HISTORY:
-                results = self._get_account_history(events[0]).tobytes()
+                results = (
+                    self._get_account_history(events[0]).tobytes() if len(events) else b""
+                )
             else:
                 results = b""
         else:
