@@ -219,7 +219,7 @@ class TestSerialPath:
         )
         run_both([accounts], [seed, balancing])
 
-    def test_limit_flags_route_serial(self):
+    def test_limit_flags_route_exact_kernel(self):
         accounts = types.batch(
             [
                 types.account(id=1, ledger=1, code=1,
@@ -237,7 +237,8 @@ class TestSerialPath:
             types.TRANSFER_DTYPE,
         )
         sm, orc = run_both([accounts], [transfers])
-        assert sm.stats["serial_batches"] >= 1
+        assert sm.stats["exact_batches"] >= 1
+        assert sm.stats["serial_batches"] == 0
 
     def test_duplicate_ids_in_batch(self):
         accounts = simple_accounts(2)
@@ -480,3 +481,135 @@ class TestNumpyBackend:
             batches.append(types.batch(batch, types.TRANSFER_DTYPE))
         sm, orc = run_both([accounts], batches, backend="numpy")
         assert sm.stats["fast_batches"] >= 2
+
+
+class TestExactKernel:
+    """Fixed-point sweep kernel (ops/commit_exact.py): convergence under
+    deep same-account dependency chains, clamp exactness, history balances."""
+
+    def test_balancing_chain_on_hot_account(self):
+        # Many balancing debits draining ONE account: each clamp depends on
+        # every predecessor (worst-case dependency depth). Must still be
+        # byte-exact — either by converging or by bailing to serial.
+        accounts = types.batch(
+            [types.account(id=i, ledger=1, code=1) for i in (1, 2, 3)],
+            types.ACCOUNT_DTYPE,
+        )
+        seed = types.batch(
+            [types.transfer(id=1, debit_account_id=2, credit_account_id=1,
+                            amount=100, ledger=1, code=1)],
+            types.TRANSFER_DTYPE,
+        )
+        drains = types.batch(
+            [
+                types.transfer(id=10 + k, debit_account_id=1, credit_account_id=3,
+                               amount=9, ledger=1, code=1,
+                               flags=TransferFlags.BALANCING_DEBIT)
+                for k in range(20)
+            ],
+            types.TRANSFER_DTYPE,
+        )
+        sm, orc = run_both([accounts], [seed, drains])
+        # 100/9 → 11 full drains, the 12th clamps to 1, the rest EXCEEDS.
+        assert orc.transfers[10 + 11].amount == 1
+
+    def test_balancing_zero_amount_sentinel(self):
+        # amount=0 + balancing → drain everything available (u64-max sentinel).
+        accounts = types.batch(
+            [types.account(id=i, ledger=1, code=1) for i in (1, 2)],
+            types.ACCOUNT_DTYPE,
+        )
+        seed = types.batch(
+            [types.transfer(id=1, debit_account_id=2, credit_account_id=1,
+                            amount=12345, ledger=1, code=1)],
+            types.TRANSFER_DTYPE,
+        )
+        drain = types.batch(
+            [types.transfer(id=2, debit_account_id=1, credit_account_id=2,
+                            amount=0, ledger=1, code=1,
+                            flags=TransferFlags.BALANCING_DEBIT)],
+            types.TRANSFER_DTYPE,
+        )
+        sm, orc = run_both([accounts], [seed, drain])
+        assert orc.transfers[2].amount == 12345
+
+    def test_limit_and_history_mixed_batch(self):
+        accounts = types.batch(
+            [
+                types.account(id=1, ledger=1, code=1,
+                              flags=AccountFlags.DEBITS_MUST_NOT_EXCEED_CREDITS
+                              | AccountFlags.HISTORY),
+                types.account(id=2, ledger=1, code=1, flags=AccountFlags.HISTORY),
+                types.account(id=3, ledger=1, code=1),
+            ],
+            types.ACCOUNT_DTYPE,
+        )
+        transfers = types.batch(
+            [
+                types.transfer(id=1, debit_account_id=3, credit_account_id=1,
+                               amount=50, ledger=1, code=1),
+                types.transfer(id=2, debit_account_id=1, credit_account_id=2,
+                               amount=30, ledger=1, code=1),
+                types.transfer(id=3, debit_account_id=1, credit_account_id=2,
+                               amount=30, ledger=1, code=1),  # exceeds credits
+                types.transfer(id=4, debit_account_id=1, credit_account_id=3,
+                               amount=20, ledger=1, code=1),
+            ],
+            types.TRANSFER_DTYPE,
+        )
+        sm, orc = run_both([accounts], [transfers])
+        assert sm.stats["exact_batches"] == 1
+        for acct in (1, 2):
+            assert sm.get_account_history(acct) == orc.get_account_history(acct)
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_balancing_limits_heavy(self, seed):
+        # BASELINE config-4-shaped randomized workload: balancing flags +
+        # must_not_exceed accounts, no linked/post/void — all batches must
+        # take the exact kernel (or bail), never diverge from the oracle.
+        rng = np.random.default_rng(1000 + seed)
+        n_accounts = 8
+        recs = []
+        for i in range(n_accounts):
+            r = rng.random()
+            flags = 0
+            if r < 0.3:
+                flags = int(AccountFlags.DEBITS_MUST_NOT_EXCEED_CREDITS)
+            elif r < 0.5:
+                flags = int(AccountFlags.CREDITS_MUST_NOT_EXCEED_DEBITS)
+            elif r < 0.6:
+                flags = int(AccountFlags.HISTORY)
+            recs.append(types.account(id=i + 1, ledger=1, code=1, flags=flags))
+        account_batches = [types.batch(recs, types.ACCOUNT_DTYPE)]
+
+        batches = []
+        next_id = 1
+        for _ in range(5):
+            batch = []
+            for _ in range(int(rng.integers(4, 32))):
+                r = rng.random()
+                flags = 0
+                if r < 0.4:
+                    flags = int(
+                        TransferFlags.BALANCING_DEBIT
+                        if rng.random() < 0.5
+                        else TransferFlags.BALANCING_CREDIT
+                    )
+                elif r < 0.5:
+                    flags = int(TransferFlags.PENDING)
+                batch.append(
+                    types.transfer(
+                        id=next_id,
+                        debit_account_id=int(rng.integers(1, n_accounts + 1)),
+                        credit_account_id=int(rng.integers(1, n_accounts + 1)),
+                        amount=int(rng.integers(0, 60)),
+                        timeout=int(rng.integers(0, 3)) if flags == int(TransferFlags.PENDING) else 0,
+                        ledger=1,
+                        code=1,
+                        flags=flags,
+                    )
+                )
+                next_id += 1
+            batches.append(types.batch(batch, types.TRANSFER_DTYPE))
+        sm, orc = run_both(account_batches, batches)
+        assert sm.stats["exact_batches"] + sm.stats["bail_batches"] >= 1

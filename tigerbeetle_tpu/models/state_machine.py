@@ -42,14 +42,18 @@ from tigerbeetle_tpu.results import CreateTransferResult as TR
 
 U64_MAX = types.U64_MAX
 
-_HARD_TRANSFER_FLAGS = np.uint16(
+# Flags that still force the serial oracle path: linked chains and
+# post/void-pending (in-batch pending resolution is the next kernel stage).
+_SERIAL_TRANSFER_FLAGS = np.uint16(
     TransferFlags.LINKED
     | TransferFlags.POST_PENDING_TRANSFER
     | TransferFlags.VOID_PENDING_TRANSFER
-    | TransferFlags.BALANCING_DEBIT
-    | TransferFlags.BALANCING_CREDIT
 )
-_HARD_ACCOUNT_FLAGS = np.uint32(
+# Flags handled by the exact (fixed-point sweep) kernel, not the simple one.
+_EXACT_TRANSFER_FLAGS = np.uint16(
+    TransferFlags.BALANCING_DEBIT | TransferFlags.BALANCING_CREDIT
+)
+_EXACT_ACCOUNT_FLAGS = np.uint32(
     AccountFlags.DEBITS_MUST_NOT_EXCEED_CREDITS
     | AccountFlags.CREDITS_MUST_NOT_EXCEED_DEBITS
     | AccountFlags.HISTORY
@@ -160,7 +164,10 @@ class StateMachine:
         self.commit_timestamp = 0
 
         # telemetry: how many batches took which path
-        self.stats = {"fast_batches": 0, "serial_batches": 0, "bail_batches": 0}
+        self.stats = {
+            "fast_batches": 0, "exact_batches": 0,
+            "serial_batches": 0, "bail_batches": 0,
+        }
 
     # ------------------------------------------------------------------
     # prepare (timestamp assignment, reference state_machine.zig:503-511)
@@ -335,7 +342,7 @@ class StateMachine:
         flags16 = events["flags"]
         keys = pack_keys(events["id_lo"], events["id_hi"])
 
-        hard = bool(np.any(flags16 & _HARD_TRANSFER_FLAGS))
+        hard = bool(np.any(flags16 & _SERIAL_TRANSFER_FLAGS))
         if not hard and n > 1:
             order = np.lexsort((keys["lo"], keys["hi"]))
             sk = keys[order]
@@ -353,8 +360,15 @@ class StateMachine:
         dr_slots[dr_slots == int(NOT_FOUND)] = -1
         cr_slots[cr_slots == int(NOT_FOUND)] = -1
 
+        # Order-dependent batches (balancing clamps, limit/history accounts)
+        # run the fixed-point exact kernel; the rest the cheaper simple one.
         touched = np.concatenate([dr_slots[dr_slots >= 0], cr_slots[cr_slots >= 0]])
-        if len(touched) and bool(np.any(self.acc_flags[touched] & _HARD_ACCOUNT_FLAGS)):
+        exact_needed = bool(np.any(flags16 & _EXACT_TRANSFER_FLAGS)) or (
+            len(touched) > 0
+            and bool(np.any(self.acc_flags[touched] & _EXACT_ACCOUNT_FLAGS))
+        )
+        if exact_needed and self._ops is None:
+            # numpy backend has no sweep kernel; exact semantics go serial.
             self.stats["serial_batches"] += 1
             return self._create_transfers_serial(events, timestamp)
 
@@ -390,9 +404,34 @@ class StateMachine:
                 events, ts, keys, dr_slots, cr_slots, host_code
             )
 
-        # Pad to a power-of-two bucket so the kernel compiles once per bucket
-        # size, not per batch length. Padding events carry a nonzero host code
-        # (never applied) and are stripped from the results.
+        b, host_code_p = self._device_batch(events, ts, dr_slots, cr_slots, host_code)
+        if exact_needed:
+            return self._create_transfers_exact(
+                events, ts, keys, dr_slots, cr_slots, b, host_code_p, timestamp
+            )
+        new_state, codes_dev, bail = self._ops.create_transfers_fast(self.state, b, host_code_p)
+        if bool(bail):
+            self.stats["bail_batches"] += 1
+            return self._create_transfers_serial(events, timestamp)
+        self.state = new_state
+        self.stats["fast_batches"] += 1
+        codes = np.asarray(codes_dev)[:n]
+
+        ok = codes == 0
+        if np.any(ok):
+            recs = events[ok].copy()
+            recs["timestamp"] = ts[ok]
+            rows = self.transfer_log.append_batch(recs)
+            self.transfer_index.insert_batch(keys[ok], rows)
+            self.commit_timestamp = int(ts[ok][-1])
+        return _codes_to_results(codes)
+
+    def _device_batch(self, events, ts, dr_slots, cr_slots, host_code):
+        """Pack events into the kernel's SoA form, padded to a power-of-two
+        bucket so each kernel compiles once per bucket size, not per batch
+        length. Padding events carry a nonzero host code (never applied) and
+        are stripped from the results."""
+        n = len(events)
         n_pad = 1 << max(4, (n - 1).bit_length())
 
         def pad1(a, fill=0):
@@ -414,24 +453,75 @@ class StateMachine:
             timeout=pad1(events["timeout"].astype(np.uint32)),
             ledger=pad1(events["ledger"].astype(np.uint32)),
             code=pad1(events["code"].astype(np.uint32)),
-            flags=pad1(flags16.astype(np.uint32)),
+            flags=pad1(events["flags"].astype(np.uint32)),
             timestamp=pad1(types.u64_to_limbs(ts)),
         )
-        new_state, codes_dev, bail = self._ops.create_transfers_fast(self.state, b, host_code_p)
+        return b, host_code_p
+
+    def _create_transfers_exact(
+        self, events, ts, keys, dr_slots, cr_slots, b, host_code_p, timestamp
+    ) -> np.ndarray:
+        """Order-dependent batches via the fixed-point sweep kernel
+        (ops/commit_exact.py): balancing clamps, limit flags, history."""
+        from tigerbeetle_tpu.ops import commit_exact
+
+        n = len(events)
+        new_state, codes_dev, amounts_dev, dr_after, cr_after, bail = (
+            commit_exact.create_transfers_exact(self.state, b, host_code_p)
+        )
         if bool(bail):
             self.stats["bail_batches"] += 1
             return self._create_transfers_serial(events, timestamp)
         self.state = new_state
-        self.stats["fast_batches"] += 1
+        self.stats["exact_batches"] += 1
         codes = np.asarray(codes_dev)[:n]
+        amounts = np.asarray(amounts_dev)[:n]
+        amt_lo, amt_hi = types.limbs_to_u64_pair(amounts)
 
         ok = codes == 0
         if np.any(ok):
+            # Transfers are stored with their POST-CLAMP amounts
+            # (state_machine.zig:1330 stores t2.amount = clamped).
             recs = events[ok].copy()
             recs["timestamp"] = ts[ok]
+            recs["amount_lo"] = amt_lo[ok]
+            recs["amount_hi"] = amt_hi[ok]
             rows = self.transfer_log.append_batch(recs)
             self.transfer_index.insert_batch(keys[ok], rows)
             self.commit_timestamp = int(ts[ok][-1])
+
+            # History rows from the kernel's post-event balances
+            # (state_machine.zig:1342-1364), in event order.
+            hist_flag = np.uint32(AccountFlags.HISTORY)
+            dr_hist = np.zeros(n, dtype=bool)
+            cr_hist = np.zeros(n, dtype=bool)
+            dr_valid = dr_slots >= 0
+            cr_valid = cr_slots >= 0
+            dr_hist[dr_valid] = (self.acc_flags[dr_slots[dr_valid]] & hist_flag) != 0
+            cr_hist[cr_valid] = (self.acc_flags[cr_slots[cr_valid]] & hist_flag) != 0
+            need = ok & (dr_hist | cr_hist)
+            if np.any(need):
+                dr_a = [np.asarray(x)[:n] for x in dr_after]
+                cr_a = [np.asarray(x)[:n] for x in cr_after]
+                for i in np.nonzero(need)[0]:
+                    row = oracle_mod.HistoryRow(timestamp=int(ts[i]))
+                    if dr_hist[i]:
+                        slot = int(dr_slots[i])
+                        key = self.acc_key[slot]
+                        row.dr_account_id = int(key["lo"]) | (int(key["hi"]) << 64)
+                        row.dr_debits_pending = types.limbs_to_int(dr_a[0][i])
+                        row.dr_debits_posted = types.limbs_to_int(dr_a[1][i])
+                        row.dr_credits_pending = types.limbs_to_int(dr_a[2][i])
+                        row.dr_credits_posted = types.limbs_to_int(dr_a[3][i])
+                    if cr_hist[i]:
+                        slot = int(cr_slots[i])
+                        key = self.acc_key[slot]
+                        row.cr_account_id = int(key["lo"]) | (int(key["hi"]) << 64)
+                        row.cr_debits_pending = types.limbs_to_int(cr_a[0][i])
+                        row.cr_debits_posted = types.limbs_to_int(cr_a[1][i])
+                        row.cr_credits_pending = types.limbs_to_int(cr_a[2][i])
+                        row.cr_credits_posted = types.limbs_to_int(cr_a[3][i])
+                    self.history.append(row)
         return _codes_to_results(codes)
 
     def _create_transfers_numpy_fast(

@@ -1,0 +1,332 @@
+"""Order-dependent create_transfers semantics on device: balancing clamps,
+limit flags, history balances — via speculative fixed-point sweeps.
+
+The reference executes these serially because each event's outcome depends on
+the balances produced by its predecessors (/root/reference/src/
+state_machine.zig:1286-1306 balancing clamps, :1323-1324 net-debit/credit cap,
+tigerbeetle.zig:31-39 limit predicates). The TPU re-expression (SURVEY.md §7
+hard part (b)) decomposes that serial dependency into data-parallel sweeps:
+
+  1. Sort the 2n (account, event) postings once by (slot, event index).
+  2. Speculate outcomes (initially: every statically-valid event succeeds
+     with its unclamped amount).
+  3. Sweep: segmented exclusive prefix sums over u16 half-limb lanes give
+     every event the exact u128 balances its account pair would hold if the
+     current speculation were true; re-run the dynamic validation ladder
+     (clamps, overflows, limit checks) against those balances.
+  4. Iterate until a fixed point. The system is triangular — event i's
+     outcome depends only on events j < i — so the fixed point is unique and
+     equals the serial execution exactly; each sweep finalizes at least one
+     more level of the dependency chain, and workloads where outcomes don't
+     flip (the common case) converge in two sweeps. A batch that has not
+     stabilized after `max_sweeps` raises `bail` and the host falls back to
+     the serial oracle.
+
+Exactness: all balance arithmetic is u128 (or wider) via uint32 limbs; prefix
+sums run in u16 half-limb lanes (≤ 2^14 terms of < 2^16 each — no wrap), so
+observed balances at the fixed point are bit-exact. The ladder below mirrors
+the reference's rung order rung-for-rung; results.py codes are
+precedence-ordered so host/device rungs merge via nonzero-minimum.
+
+Stage limits (host dispatcher enforces): linked chains, post/void-pending,
+and duplicate/existing transfer ids still route to the serial path; this
+kernel covers balancing/limit/history batches (BASELINE config 4) plus
+everything the simple kernel handles.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from tigerbeetle_tpu.ops import u128
+from tigerbeetle_tpu.ops.commit import (
+    AF_CREDITS_MUST_NOT_EXCEED_DEBITS,
+    AF_DEBITS_MUST_NOT_EXCEED_CREDITS,
+    F_BAL_CR,
+    F_BAL_DR,
+    F_LINKED,
+    F_PADDING,
+    F_PENDING,
+    F_POST,
+    F_VOID,
+    NS_PER_S,
+    LedgerState,
+    TransferBatch,
+    _ladder,
+    apply_posting_streamed,
+    merge_codes,
+)
+from tigerbeetle_tpu.results import CreateTransferResult as TR
+
+U32 = jnp.uint32
+MAX_SWEEPS = 64
+
+_U64_MAX_LIMBS = (0xFFFFFFFF, 0xFFFFFFFF, 0, 0)
+
+BAL_FIELDS = ("debits_pending", "debits_posted", "credits_pending", "credits_posted")
+
+
+class Observed(NamedTuple):
+    """Pre-event balances one side of each event sees on its account."""
+
+    debits_pending: jnp.ndarray  # (n, 4) u32
+    debits_posted: jnp.ndarray
+    credits_pending: jnp.ndarray
+    credits_posted: jnp.ndarray
+
+
+def _static_ladder(state: LedgerState, b: TransferBatch):
+    """Order-independent rungs (reference ladder up to the exists check),
+    with the balancing amendment: zero amount is legal when a balancing flag
+    is set (the clamp sentinel applies instead, state_machine.zig:1291)."""
+    n = b.flags.shape[0]
+    flags = b.flags
+    pend = (flags & F_PENDING) != 0
+    balancing = (flags & (F_BAL_DR | F_BAL_CR)) != 0
+
+    code = jnp.zeros((n,), dtype=U32)
+    code = _ladder(code, (flags & F_PADDING) != 0, TR.RESERVED_FLAG)
+    code = _ladder(code, u128.is_zero(b.id), TR.ID_MUST_NOT_BE_ZERO)
+    code = _ladder(code, u128.is_max(b.id), TR.ID_MUST_NOT_BE_INT_MAX)
+    code = _ladder(code, ~u128.is_zero(b.pending_id), TR.PENDING_ID_MUST_BE_ZERO)
+    code = _ladder(code, ~pend & (b.timeout != 0), TR.TIMEOUT_RESERVED_FOR_PENDING_TRANSFER)
+    code = _ladder(code, ~balancing & u128.is_zero(b.amount), TR.AMOUNT_MUST_NOT_BE_ZERO)
+    code = _ladder(code, b.ledger == 0, TR.LEDGER_MUST_NOT_BE_ZERO)
+    code = _ladder(code, b.code == 0, TR.CODE_MUST_NOT_BE_ZERO)
+
+    code = _ladder(code, b.dr_slot < 0, TR.DEBIT_ACCOUNT_NOT_FOUND)
+    code = _ladder(code, b.cr_slot < 0, TR.CREDIT_ACCOUNT_NOT_FOUND)
+
+    a_max = state.ledger.shape[0] - 1
+    dr_ledger = state.ledger[jnp.clip(b.dr_slot, 0, a_max)]
+    cr_ledger = state.ledger[jnp.clip(b.cr_slot, 0, a_max)]
+    code = _ladder(code, dr_ledger != cr_ledger, TR.ACCOUNTS_MUST_HAVE_THE_SAME_LEDGER)
+    code = _ladder(
+        code, b.ledger != dr_ledger, TR.TRANSFER_MUST_HAVE_THE_SAME_LEDGER_AS_ACCOUNTS
+    )
+    return code
+
+
+def _timeout_overflows(b: TransferBatch):
+    """t.timestamp + t.timeout * 1e9 > maxInt(u64) (state_machine.zig:1326)."""
+    assert NS_PER_S < (1 << 32)
+    timeout_ns = u128.mul_u32(b.timeout, jnp.uint32(NS_PER_S))
+    _, over = u128.add(b.timestamp, timeout_ns)
+    return over
+
+
+def _seg_exclusive_cumsum(vals_sorted: jnp.ndarray, head_pos: jnp.ndarray):
+    """Per-segment exclusive prefix sums along axis 0.
+
+    vals_sorted: (m, k) u32 half-limb lanes in segment-sorted order;
+    head_pos: (m,) i32 — index of each position's segment head.
+    Lanes hold values < 2^16 and m ≤ 2^16, so the plain cumsum cannot wrap.
+    """
+    m = vals_sorted.shape[0]
+    c = jnp.cumsum(vals_sorted, axis=0, dtype=U32)
+    cpad = jnp.concatenate([jnp.zeros((1, c.shape[1]), dtype=U32), c], axis=0)
+    pos = jnp.arange(m)
+    return cpad[pos] - cpad[head_pos]
+
+
+def _add3_wide(a, b, c):
+    """Exact a + b + c for u128 limb values, as (…, 5)-limb u160."""
+    s1, _ = u128.add(u128.widen(a, 5), u128.widen(b, 5))
+    s2, _ = u128.add(s1, u128.widen(c, 5))
+    return s2
+
+
+def create_transfers_exact_impl(
+    state: LedgerState,
+    b: TransferBatch,
+    host_code: jnp.ndarray,
+    max_sweeps: int = MAX_SWEEPS,
+):
+    """Fixed-point commit for order-dependent batches.
+
+    Returns (new_state, codes (n,), amounts (n,4) — post-clamp, dr_after,
+    cr_after (Observed — post-event balances for history rows), bail).
+    `bail` is True when the batch did not stabilize within max_sweeps, an
+    unsupported flag (linked/post/void) is present, or a posting overflow
+    fired — the host must redo the batch serially.
+    """
+    n = b.flags.shape[0]
+    a_count = state.ledger.shape[0]
+    a_max = a_count - 1
+    flags = b.flags
+    pend = (flags & F_PENDING) != 0
+    bal_dr = (flags & F_BAL_DR) != 0
+    bal_cr = (flags & F_BAL_CR) != 0
+    balancing = bal_dr | bal_cr
+    unsupported = (flags & (F_LINKED | F_POST | F_VOID)) != 0
+
+    static_code = merge_codes(_static_ladder(state, b), host_code)
+    ts_over = _timeout_overflows(b)
+
+    dr_ix = jnp.clip(b.dr_slot, 0, a_max)
+    cr_ix = jnp.clip(b.cr_slot, 0, a_max)
+    dr_limit = (state.flags[dr_ix] & AF_DEBITS_MUST_NOT_EXCEED_CREDITS) != 0
+    cr_limit = (state.flags[cr_ix] & AF_CREDITS_MUST_NOT_EXCEED_DEBITS) != 0
+
+    # Balancing zero-amount sentinel is maxInt(u64), not u128.
+    u64max = jnp.broadcast_to(
+        jnp.array(_U64_MAX_LIMBS, dtype=U32), (n, 4)
+    )
+    amount0 = u128.select(balancing & u128.is_zero(b.amount), u64max, b.amount)
+
+    # --- static sort of the 2n (slot, event) postings ------------------
+    idx = jnp.arange(n, dtype=jnp.int32)
+    rec_slot = jnp.concatenate([b.dr_slot, b.cr_slot]).astype(jnp.int32)
+    rec_idx = jnp.concatenate([idx, idx])
+    sort_slot = jnp.where(rec_slot >= 0, rec_slot, jnp.int32(a_count))
+    sorted_slot, _sorted_idx, perm = jax.lax.sort(
+        (sort_slot, rec_idx, jnp.arange(2 * n, dtype=jnp.int32)),
+        num_keys=2,
+        is_stable=True,
+    )
+    seg_head = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), sorted_slot[1:] != sorted_slot[:-1]]
+    )
+    head_pos = jax.lax.cummax(
+        jnp.where(seg_head, jnp.arange(2 * n, dtype=jnp.int32), 0)
+    )
+    base = Observed(*[
+        getattr(state, f)[jnp.clip(rec_slot, 0, a_max)] for f in BAL_FIELDS
+    ])
+
+    zeros_n8 = jnp.zeros((n, 8), dtype=U32)
+
+    def observe(ok: jnp.ndarray, amount: jnp.ndarray):
+        """Balances each posting record sees given the current speculation."""
+        amt_h = u128.split_u16(amount)  # (n, 8)
+        d_pend = jnp.where((ok & pend)[:, None], amt_h, zeros_n8)
+        d_post = jnp.where((ok & ~pend)[:, None], amt_h, zeros_n8)
+        rec_vals = {
+            "debits_pending": jnp.concatenate([d_pend, zeros_n8]),
+            "debits_posted": jnp.concatenate([d_post, zeros_n8]),
+            "credits_pending": jnp.concatenate([zeros_n8, d_pend]),
+            "credits_posted": jnp.concatenate([zeros_n8, d_post]),
+        }
+        obs = {}
+        for f, vals in rec_vals.items():
+            prefix_sorted = _seg_exclusive_cumsum(vals[perm], head_pos)
+            prefix = jnp.zeros_like(prefix_sorted).at[perm].set(prefix_sorted)
+            delta, _ = u128.combine_u16(prefix)
+            obs[f], _ = u128.add(base._asdict()[f], delta)
+        return Observed(**obs)
+
+    def evaluate(obs: Observed):
+        """Dynamic ladder given observed balances; returns (code, amount)."""
+        dr = Observed(*[x[:n] for x in obs])
+        cr = Observed(*[x[n:] for x in obs])
+        code = static_code
+        amt = amount0
+
+        # Balancing clamps (state_machine.zig:1286-1306): amount is capped at
+        # what the account can absorb without breaching its net balance.
+        dr_bal = _add3_wide(dr.debits_pending, dr.debits_posted, jnp.zeros_like(amt))
+        avail_d5, under_d = u128.sub(u128.widen(dr.credits_posted, 5), dr_bal)
+        avail_d = u128.select(under_d, jnp.zeros((n, 4), dtype=U32), avail_d5[..., :4])
+        amt = u128.select(bal_dr, u128.min_(amt, avail_d), amt)
+        code = _ladder(code, bal_dr & u128.is_zero(amt), TR.EXCEEDS_CREDITS)
+
+        cr_bal = _add3_wide(cr.credits_pending, cr.credits_posted, jnp.zeros_like(amt))
+        avail_c5, under_c = u128.sub(u128.widen(cr.debits_posted, 5), cr_bal)
+        avail_c = u128.select(under_c, jnp.zeros((n, 4), dtype=U32), avail_c5[..., :4])
+        amt2 = u128.select(bal_cr, u128.min_(amt, avail_c), amt)
+        code = _ladder(code, bal_cr & u128.is_zero(amt2) & ~u128.is_zero(amt),
+                       TR.EXCEEDS_DEBITS)
+        amt = amt2
+
+        # Overflow rungs (state_machine.zig:1308-1324), in reference order.
+        code = _ladder(
+            code, pend & u128.sum_overflows(amt, dr.debits_pending),
+            TR.OVERFLOWS_DEBITS_PENDING,
+        )
+        code = _ladder(
+            code, pend & u128.sum_overflows(amt, cr.credits_pending),
+            TR.OVERFLOWS_CREDITS_PENDING,
+        )
+        code = _ladder(
+            code, u128.sum_overflows(amt, dr.debits_posted), TR.OVERFLOWS_DEBITS_POSTED
+        )
+        code = _ladder(
+            code, u128.sum_overflows(amt, cr.credits_posted), TR.OVERFLOWS_CREDITS_POSTED
+        )
+        u128_top = u128.widen(jnp.broadcast_to(jnp.array(
+            [0xFFFFFFFF] * 4, dtype=U32), (n, 4)), 5)
+        over_d = u128.gt(_add3_wide(dr.debits_pending, dr.debits_posted, amt), u128_top)
+        code = _ladder(code, over_d, TR.OVERFLOWS_DEBITS)
+        over_c = u128.gt(_add3_wide(cr.credits_pending, cr.credits_posted, amt), u128_top)
+        code = _ladder(code, over_c, TR.OVERFLOWS_CREDITS)
+        code = _ladder(code, ts_over, TR.OVERFLOWS_TIMEOUT)
+
+        # Limit flags (tigerbeetle.zig:31-39).
+        exceed_d = dr_limit & u128.gt(
+            _add3_wide(dr.debits_pending, dr.debits_posted, amt),
+            u128.widen(dr.credits_posted, 5),
+        )
+        code = _ladder(code, exceed_d, TR.EXCEEDS_CREDITS)
+        exceed_c = cr_limit & u128.gt(
+            _add3_wide(cr.credits_pending, cr.credits_posted, amt),
+            u128.widen(cr.debits_posted, 5),
+        )
+        code = _ladder(code, exceed_c, TR.EXCEEDS_DEBITS)
+        return code, amt
+
+    def masked(ok, amount):
+        return u128.select(ok, amount, jnp.zeros_like(amount))
+
+    def sweep(carry):
+        ok, amount, it, _ = carry
+        obs = observe(ok, amount)
+        code, amt = evaluate(obs)
+        new_ok = code == 0
+        stable = jnp.all(new_ok == ok) & jnp.all(masked(new_ok, amt) == masked(ok, amount))
+        return new_ok, masked(new_ok, amt), it + 1, stable
+
+    init_ok = static_code == 0
+    init = (init_ok, masked(init_ok, amount0), jnp.int32(0), jnp.array(False))
+    ok, amount, sweeps, stable = jax.lax.while_loop(
+        lambda c: (~c[3]) & (c[2] < max_sweeps), sweep, init
+    )
+
+    # Final consistent evaluation: codes + the balances history rows need.
+    obs = observe(ok, amount)
+    codes, amounts = evaluate(obs)
+    ok = codes == 0
+    amounts = masked(ok, amounts)
+
+    new_state, overflow = apply_posting_streamed(
+        state, b.dr_slot, b.cr_slot, amounts,
+        dr_pend=ok & pend, dr_post=ok & ~pend,
+        cr_pend=ok & pend, cr_post=ok & ~pend,
+    )
+
+    # Post-event balances (observed + own delta) for history rows
+    # (state_machine.zig:1342-1364 snapshots balances after the transfer).
+    dr_obs = Observed(*[x[:n] for x in obs])
+    cr_obs = Observed(*[x[n:] for x in obs])
+    amt_pend = masked(ok & pend, amounts)
+    amt_post = masked(ok & ~pend, amounts)
+    dr_after = Observed(
+        debits_pending=u128.add(dr_obs.debits_pending, amt_pend)[0],
+        debits_posted=u128.add(dr_obs.debits_posted, amt_post)[0],
+        credits_pending=dr_obs.credits_pending,
+        credits_posted=dr_obs.credits_posted,
+    )
+    cr_after = Observed(
+        debits_pending=cr_obs.debits_pending,
+        debits_posted=cr_obs.debits_posted,
+        credits_pending=u128.add(cr_obs.credits_pending, amt_pend)[0],
+        credits_posted=u128.add(cr_obs.credits_posted, amt_post)[0],
+    )
+
+    bail = (~stable) | overflow | jnp.any(unsupported)
+    return new_state, codes, amounts, dr_after, cr_after, bail
+
+
+create_transfers_exact = jax.jit(create_transfers_exact_impl, static_argnames=("max_sweeps",))
