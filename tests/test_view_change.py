@@ -92,6 +92,109 @@ class TestJournalGuards:
         assert not j3.dirty and j3.highest_op() == 1
 
 
+class TestDurableRepairTargets:
+    def test_install_header_marks_slot_faulty_across_recovery(self):
+        """A winning-log header installed without its body must survive a
+        restart as a faulty (repair-needed) slot — never serving the stale
+        body it overlays (ADVICE r2: repair_target was in-memory only)."""
+        zone = Zone.for_config(
+            TEST_MIN.journal_slot_count, TEST_MIN.message_size_max, TEST_MIN.clients_max
+        )
+        storage = MemStorage(zone.total_size, seed=2)
+        j = Journal(storage, zone, TEST_MIN.journal_slot_count, TEST_MIN.message_size_max)
+        stale = _prepare(0, view=0, op=5, timestamp=9, body=b"stale")
+        j.write_prepare(stale)
+        target = _prepare(0, view=2, op=5, timestamp=11, body=b"winning").header
+        j.install_header(target)
+        # In-memory: the ring header is the contract; the body mismatches.
+        assert j.slot_for_op(5) in j.faulty
+        assert j.read_prepare(5) is None
+        # Durable: a fresh recovery classifies the same way.
+        j2 = Journal(storage, zone, TEST_MIN.journal_slot_count, TEST_MIN.message_size_max)
+        j2.recover(0)
+        slot = j2.slot_for_op(5)
+        assert slot in j2.faulty
+        assert j2.headers[slot]["checksum"] == target["checksum"]
+        assert j2.read_prepare(5) is None
+        # The winning body arrives: slot heals.
+        win = _prepare(0, view=2, op=5, timestamp=11, body=b"winning")
+        j2.write_prepare(win)
+        assert j2.slot_for_op(5) not in j2.faulty
+        got = j2.read_prepare(5)
+        assert got is not None and got.header["checksum"] == win.header["checksum"]
+
+    def test_pending_repair_target_not_replayed_after_restart(self):
+        """Crash with a repair target pending at op X <= persisted commit_max:
+        restart must NOT execute the stale divergent body at X (ADVICE r2
+        medium — permanent state-machine divergence)."""
+        cl = Cluster(replica_count=3, seed=14)
+        c = setup_client(cl)
+        do_request(cl, c, Operation.CREATE_ACCOUNTS, account_batch([1, 2]))
+        cl.run_until(lambda: all(r.commit_min == r.commit_max for r in cl.replicas))
+        rb = next(r for r in cl.replicas if not r.is_primary)
+        i = rb.replica
+        base_op = rb.commit_min
+        ts = rb.state_machine.prepare_timestamp
+        x = base_op + 1
+
+        # Stale divergent content A at X (uncommitted, local only).
+        stale = _prepare(
+            cl.cluster_id, view=rb.view, op=x, timestamp=ts + 1, body=account_batch([77])
+        )
+        rb.journal.write_prepare(stale)
+        rb.op = x
+
+        # A START_VIEW from a newer view declares winning content B at X as
+        # committed; the prepare body has not arrived yet.
+        v = rb.view + 1
+        while v % cl.replica_count == i:
+            v += 1
+        win = _prepare(
+            cl.cluster_id, view=v, op=x, timestamp=ts + 2,
+            body=account_batch([88]), replica=v % cl.replica_count,
+        )
+        sv = hdr.make(
+            Command.START_VIEW, cl.cluster_id, view=v,
+            replica=v % cl.replica_count, op=x, commit=x,
+        )
+        rb.on_message(Message(sv, win.header.to_bytes()).seal())
+        assert rb.commit_min == base_op  # X could not commit: body missing
+        assert rb.journal.slot_for_op(x) in rb.journal.faulty
+
+        # Simulate a checkpoint that persisted commit_max beyond commit_min.
+        rb.superblock.state.commit_max = x
+        rb.superblock.checkpoint()
+        cl.storages[i].sync()
+        cl.crash_replica(i)
+        cl.restart_replica(i)
+        rb2 = cl.replicas[i]
+
+        # The stale body must not have been executed during replay.
+        out = rb2.state_machine.lookup_accounts(
+            np.array([77, 88], dtype=np.uint64), np.array([0, 0], dtype=np.uint64)
+        )
+        assert len(out) == 0
+        assert rb2.commit_min == base_op
+        slot = rb2.journal.slot_for_op(x)
+        assert slot in rb2.journal.faulty
+        assert rb2.journal.headers[slot]["checksum_body"] == win.header["checksum_body"]
+
+        # A re-delivery of the stale old-view prepare must still be rejected,
+        # while the winning body heals the slot and commits.
+        rb2.status = "normal"  # bypass recovering gate for direct delivery
+        rb2.on_message(stale)
+        assert rb2.journal.read_prepare(x) is None
+        rb2.on_message(win)
+        got = rb2.journal.read_prepare(x)
+        assert got is not None
+        assert got.header["checksum_body"] == win.header["checksum_body"]
+        rb2._commit_journal(x)
+        out = rb2.state_machine.lookup_accounts(
+            np.array([77, 88], dtype=np.uint64), np.array([0, 0], dtype=np.uint64)
+        )
+        assert {int(r["id_lo"]) for r in out} == {88}
+
+
 class TestPoisonPill:
     def test_zero_event_filter_request_rejected(self):
         cl = Cluster(replica_count=1)

@@ -126,6 +126,9 @@ def _seg_exclusive_cumsum(vals_sorted: jnp.ndarray, head_pos: jnp.ndarray):
     Lanes hold values < 2^16 and m ≤ 2^16, so the plain cumsum cannot wrap.
     """
     m = vals_sorted.shape[0]
+    # Exactness bound: m terms of < 2^16 each must not wrap u32 — static
+    # shape check, free at trace time (u128.scatter_add asserts the same).
+    assert m <= (1 << 16), f"segmented cumsum exactness requires m <= 2^16, got {m}"
     c = jnp.cumsum(vals_sorted, axis=0, dtype=U32)
     cpad = jnp.concatenate([jnp.zeros((1, c.shape[1]), dtype=U32), c], axis=0)
     pos = jnp.arange(m)

@@ -85,6 +85,28 @@ class Journal:
         self.dirty.discard(slot)
         self.faulty.discard(slot)
 
+    def install_header(self, header: Header, sync: bool = True) -> None:
+        """Durably install a winning-log header WITHOUT its body (reference
+        replace_header: view-change repair targets are written to the header
+        ring so a crash cannot forget them). The slot is marked faulty — the
+        stale/missing body must arrive via repair before the op may be read,
+        committed, or served; recovery re-classifies the slot the same way
+        (redundant header newer than body → faulty)."""
+        op = header["op"]
+        assert self.can_write(op)
+        slot = self.slot_for_op(op)
+        existing = self.headers.get(slot)
+        if existing is not None and existing["checksum"] == header["checksum"]:
+            return  # already holds exactly this content
+        self.storage.write(
+            self.zone.wal_headers_offset + slot * HEADER_SIZE, header.to_bytes()
+        )
+        if sync:
+            self.storage.sync()
+        self.headers[slot] = header.copy()
+        self.dirty.discard(slot)
+        self.faulty.add(slot)
+
     def truncate(self, op_max: int) -> None:
         """Drop every journal entry above op_max (view-change truncation of
         uncommitted ops not in the winning log — reference DVCQuorum nacks)."""
@@ -119,6 +141,11 @@ class Journal:
         )
         msg = Message.from_bytes(raw)
         if not msg.verify() or msg.header["op"] != op:
+            return None
+        if msg.header["checksum"] != h["checksum"]:
+            # The body is internally valid but is not the content the header
+            # ring promises (an installed repair target, or a crash mid-
+            # overwrite): it must never be executed or served.
             return None
         return msg
 
@@ -160,7 +187,20 @@ class Journal:
             if header_ok and prepare_ok and rh["checksum"] == ph["checksum"]:
                 self.headers[slot] = rh
                 out.append(rh)
-            elif header_ok and not prepare_ok:
+            elif header_ok and prepare_ok:
+                # Both rings valid but disagree (journal.zig recovery cases
+                # for checksum mismatch): the side with the newer op wins;
+                # at equal ops the redundant header records newer intent (an
+                # installed repair target or a crash mid-re-proposal) and
+                # the body must be repaired before use.
+                if ph["op"] > rh["op"]:
+                    self.headers[slot] = ph
+                    out.append(ph)
+                    self.dirty.add(slot)  # header ring needs rewrite
+                else:
+                    self.headers[slot] = rh
+                    self.faulty.add(slot)
+            elif header_ok:
                 # Redundant header says a prepare should be here: torn body.
                 self.headers[slot] = rh
                 self.faulty.add(slot)

@@ -137,8 +137,12 @@ class Replica:
         # bodies. A local prepare whose body differs is stale and must be
         # repaired before it may be re-proposed, committed, or served to
         # peers. Replaced wholesale at each view change; entries are popped
-        # as their ops are repaired or committed.
+        # as their ops are repaired or committed. Quorum-backed (DVC/SV)
+        # targets are additionally installed into the journal header ring so
+        # they survive restart (reference replace_header); HEADERS-derived
+        # targets are weaker — in-memory only, aged out on repair timeout.
         self.repair_target: Dict[int, Header] = {}
+        self.repair_target_weak: Dict[int, int] = {}  # op → install tick
 
         self.tick_count = 0
         self.last_heartbeat_tick = 0
@@ -481,12 +485,24 @@ class Replica:
             if op > self.op or not self.journal.can_write(op):
                 return
             target = self.repair_target.get(op)
+            if target is None:
+                # After a restart the in-memory map is empty, but durable
+                # targets live on as faulty header-ring slots: the ring
+                # header is the content contract for the arriving body.
+                slot = self.journal.slot_for_op(op)
+                if slot in self.journal.faulty:
+                    ring = self.journal.headers.get(slot)
+                    if ring is not None and ring["op"] == op:
+                        target = ring
             if target is not None and not self._content_eq(h, target):
-                return  # not the content the winning log requires
+                if not (op in self.repair_target_weak and h["view"] > target["view"]):
+                    return  # not the content the winning log requires
+                # A weak (HEADERS-derived) target is superseded by genuinely
+                # newer-view content — the weak header was stale.
             if not self._journal_has_target(op) or self.journal.read_prepare(op) is None:
                 # Hole, torn body, or stale content: install the repair.
                 self.journal.write_prepare(msg)
-            self.repair_target.pop(op, None)
+            self._drop_target(op)
             self._commit_journal(self.commit_max)
             if self.is_primary and self.op > self.commit_min:
                 self._reproposal_pipeline(self.view)
@@ -498,7 +514,7 @@ class Replica:
         if op <= self.op:
             existing = self.journal.read_prepare(op)
             if existing is not None and existing.header["checksum"] == h["checksum"]:
-                self.repair_target.pop(op, None)
+                self._drop_target(op)
                 self._send_prepare_ok(h)
                 self._commit_journal(h["commit"])
                 return
@@ -507,7 +523,7 @@ class Replica:
             ):
                 # Re-proposed in a newer view (post view-change): overwrite.
                 self.journal.write_prepare(msg)
-                self.repair_target.pop(op, None)
+                self._drop_target(op)
                 self._send_prepare_ok(h)
                 self._commit_journal(h["commit"])
             return
@@ -615,7 +631,7 @@ class Replica:
                 break
             self._execute(msg)
             self.commit_min += 1
-            self.repair_target.pop(op, None)
+            self._drop_target(op)
             self._maybe_checkpoint()
         if self.is_primary and self.pipeline:
             self._check_pipeline_quorum()
@@ -632,6 +648,15 @@ class Replica:
         if self.tick_count - self.last_repair_tick < REPAIR_TIMEOUT and target is None:
             return
         self.last_repair_tick = self.tick_count
+        # Weak (HEADERS-derived, non-quorum-backed) targets whose content
+        # never arrived may be pinning an op to a stale header from a lying
+        # or lagging peer — age them out so repair can re-learn the op.
+        expired = [
+            op for op, t0 in self.repair_target_weak.items()
+            if self.tick_count - t0 > 4 * REPAIR_TIMEOUT
+        ]
+        for op in expired:
+            self._drop_target(op)
         peer = self._repair_peer()
         limit = target if target is not None else self.commit_max
         # Ops needing a prepare: journal holes up to the commit target,
@@ -667,6 +692,27 @@ class Replica:
                 commit=limit + 1, op=self.op,
             )
             self.bus.send_to_replica(peer, Message(rh).seal())
+
+    def _drop_target(self, op: int) -> None:
+        self.repair_target.pop(op, None)
+        self.repair_target_weak.pop(op, None)
+
+    def _set_targets(self, targets: Dict[int, Header]) -> None:
+        """Install quorum-backed winning-log targets wholesale (view change).
+
+        Each target is also written into the journal header ring (reference
+        replace_header): a replica that crashes with a pending target must
+        not, on restart, replay the stale divergent body at that op as
+        committed — recovery re-classifies the slot faulty and repair
+        re-fetches the winning content.
+        """
+        self.repair_target = dict(targets)
+        self.repair_target_weak = {}
+        for op in sorted(targets):
+            if self.journal.can_write(op):
+                self.journal.install_header(targets[op], sync=False)
+        if targets:
+            self.storage.sync()
 
     def _journal_has_target(self, op: int) -> bool:
         """Is the journal's content at op trustworthy: present, not torn,
@@ -715,7 +761,16 @@ class Replica:
                 continue
             if self._journal_has_op(op) or op in self.repair_target:
                 continue
+            # A faulty slot whose ring header already names this op holds a
+            # durable quorum-backed target (install_header, possibly from
+            # before a restart) — a weak HEADERS target must not shadow it.
+            slot = self.journal.slot_for_op(op)
+            if slot in self.journal.faulty:
+                ring = self.journal.headers.get(slot)
+                if ring is not None and ring["op"] == op:
+                    continue
             self.repair_target[op] = h
+            self.repair_target_weak[op] = self.tick_count
             rp = hdr.make(
                 Command.REQUEST_PREPARE, self.cluster,
                 view=self.view, op=op, replica=self.replica,
@@ -820,7 +875,11 @@ class Replica:
         if self._dvc_sent_for_view >= v:
             return
         self._dvc_sent_for_view = v
-        headers = self._recent_headers()
+        # Advertise the WINNING log, not the raw journal: where a repair
+        # target is pending the local journal content is stale, and a DVC
+        # carrying it could win the candidate merge and resurrect divergent
+        # content (the exact divergence view change exists to prevent).
+        headers = self._sv_body_headers()
         dvc = hdr.make(
             Command.DO_VIEW_CHANGE, self.cluster,
             view=v, replica=self.replica, op=self.op,
@@ -833,14 +892,6 @@ class Replica:
             self.on_do_view_change(m)
         else:
             self.bus.send_to_replica(primary, m)
-
-    def _recent_headers(self) -> List[Header]:
-        out = []
-        for op in range(max(1, self.op - 32), self.op + 1):
-            h = self.journal.headers.get(self.journal.slot_for_op(op))
-            if h is not None and h["op"] == op:
-                out.append(h)
-        return out
 
     def _sv_body_headers(self) -> List[Header]:
         """Headers describing the WINNING log for a START_VIEW body: where a
@@ -885,13 +936,25 @@ class Replica:
 
         # Merge the candidates' header windows. Within one log_view every op
         # slot was assigned exactly once by that view's primary, so shared
-        # ops agree on content; any candidate's copy is authoritative.
+        # ops normally agree on content. A conflict can still appear if a
+        # candidate advertises content it has not yet repaired (stale body
+        # from an older prepare view): resolve deterministically — the
+        # header whose prepare carries the higher view is the re-proposal
+        # the winning log kept; tie-break on checksum_body so every replica
+        # computes the same merge regardless of DVC arrival order.
         merged: Dict[int, Header] = {}
         senders: Dict[int, int] = {}
         for m in candidates:
             for h in _parse_headers(m.body):
-                merged[h["op"]] = h
-                senders[h["op"]] = m.header["replica"]
+                op_h = h["op"]
+                prev = merged.get(op_h)
+                if prev is not None and not self._content_eq(prev, h):
+                    if (h["view"], h["checksum_body"]) <= (
+                        prev["view"], prev["checksum_body"]
+                    ):
+                        continue
+                merged[op_h] = h
+                senders[op_h] = m.header["replica"]
 
         if self.op > new_op:
             self.journal.truncate(new_op)
@@ -901,18 +964,20 @@ class Replica:
         # Install the winning content as repair targets: local prepares whose
         # body differs are stale and may not be re-proposed until repaired.
         # Wholesale replacement — targets from earlier views are obsolete.
-        self.repair_target = {}
+        targets: Dict[int, Header] = {}
         for op, h in merged.items():
             if op <= self.commit_min or op > new_op:
                 continue
             if not self._journal_matches(op, h):
-                self.repair_target[op] = h
-                if senders[op] != self.replica:
-                    rp = hdr.make(
-                        Command.REQUEST_PREPARE, self.cluster,
-                        view=v, op=op, replica=self.replica,
-                    )
-                    self.bus.send_to_replica(senders[op], Message(rp).seal())
+                targets[op] = h
+        self._set_targets(targets)
+        for op in sorted(targets):
+            if senders[op] != self.replica:
+                rp = hdr.make(
+                    Command.REQUEST_PREPARE, self.cluster,
+                    view=v, op=op, replica=self.replica,
+                )
+                self.bus.send_to_replica(senders[op], Message(rp).seal())
 
         # Become primary of the new view.
         self.status = STATUS_NORMAL
@@ -979,7 +1044,7 @@ class Replica:
                     if r != self.replica:
                         self.bus.send_to_replica(r, m)
                 break
-            self.repair_target.pop(op, None)
+            self._drop_target(op)
             h = msg.header
             prev = self.journal.headers.get(self.journal.slot_for_op(op - 1))
             nh = hdr.make(
@@ -1018,18 +1083,20 @@ class Replica:
             self.journal.truncate(new_op)
         self.op = max(new_op, self.commit_min)
         primary = h["replica"]
-        self.repair_target = {}
+        targets: Dict[int, Header] = {}
         for sh in _parse_headers(msg.body):
             op = sh["op"]
             if op <= self.commit_min or op > new_op:
                 continue
             if not self._journal_matches(op, sh):
-                self.repair_target[op] = sh
-                rp = hdr.make(
-                    Command.REQUEST_PREPARE, self.cluster,
-                    view=v, op=op, replica=self.replica,
-                )
-                self.bus.send_to_replica(primary, Message(rp).seal())
+                targets[op] = sh
+        self._set_targets(targets)
+        for op in sorted(targets):
+            rp = hdr.make(
+                Command.REQUEST_PREPARE, self.cluster,
+                view=v, op=op, replica=self.replica,
+            )
+            self.bus.send_to_replica(primary, Message(rp).seal())
         self._persist_view()
         self._commit_journal(h["commit"])
         self.on_event("view_change", self)
