@@ -23,7 +23,6 @@ an identical execution, byte-for-byte (SURVEY.md §4 keystone).
 
 from __future__ import annotations
 
-import io as _io
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -33,6 +32,7 @@ from tigerbeetle_tpu.constants import Config
 from tigerbeetle_tpu.io.storage import Zone
 from tigerbeetle_tpu.models.state_machine import StateMachine
 from tigerbeetle_tpu.vsr import header as hdr
+from tigerbeetle_tpu.vsr import snapshot
 from tigerbeetle_tpu.vsr.header import Command, Header, Message, Operation
 from tigerbeetle_tpu.vsr.journal import Journal
 from tigerbeetle_tpu.vsr.superblock import SuperBlock, VSRState
@@ -144,6 +144,11 @@ class Replica:
         self.repair_target: Dict[int, Header] = {}
         self.repair_target_weak: Dict[int, int] = {}  # op → install tick
 
+        # Chunked state-sync progress (receiver side) and the serve-side
+        # (checkpoint_op, blob, checksum) cache.
+        self._sync: Optional[dict] = None
+        self._sync_serve_cache: Optional[tuple] = None
+
         self.tick_count = 0
         self.last_heartbeat_tick = 0
         self.last_commit_sent_tick = 0
@@ -254,6 +259,7 @@ class Replica:
 
     def tick(self) -> None:
         self.tick_count += 1
+        self._sync_tick()
         if self.status == STATUS_NORMAL:
             if self.is_primary:
                 if self.tick_count - self.last_commit_sent_tick >= COMMIT_HEARTBEAT_TIMEOUT:
@@ -319,6 +325,7 @@ class Replica:
             Command.REQUEST_PREPARE: self.on_request_prepare,
             Command.REQUEST_HEADERS: self.on_request_headers,
             Command.HEADERS: self.on_headers,
+            Command.REQUEST_SYNC_CHECKPOINT: self.on_request_sync_checkpoint,
             Command.SYNC_CHECKPOINT: self.on_sync_checkpoint,
             Command.PING: self.on_ping,
             Command.PONG: self.on_pong,
@@ -787,34 +794,144 @@ class Replica:
             return
         # The requested op predates our checkpoint (WAL ring wrapped): the
         # requester is too far behind for WAL repair and must state-sync
-        # (reference docs/internals/sync.md; replica.zig:7765+). Send our
-        # checkpoint snapshot. TODO: chunk via grid blocks for large states.
+        # (reference docs/internals/sync.md; replica.zig:7765+). Start the
+        # chunked transfer: the first chunk announces (count, size, whole-
+        # blob checksum); the requester pulls the rest.
         st = self.superblock.state
         if op <= st.op_checkpoint and self.snapshot_store is not None:
-            blob = self.snapshot_store.load(st.op_checkpoint)
-            if blob is not None:
-                sc = hdr.make(
-                    Command.SYNC_CHECKPOINT, self.cluster,
-                    view=self.view, replica=self.replica,
-                    op=st.op_checkpoint, commit=self.commit_min,
-                    checkpoint_op=st.op_checkpoint,
-                )
-                self.bus.send_to_replica(
-                    msg.header["replica"], Message(sc, blob).seal()
-                )
+            self._send_sync_chunk(msg.header["replica"], 0)
+
+    # --- state sync (chunked; reference sync.zig + docs/internals/sync.md) -
+
+    SYNC_CHUNKS_IN_FLIGHT = 4  # request pipelining for large snapshots
+
+    def _sync_blob(self) -> Optional[tuple]:
+        """(checkpoint_op, blob, whole-blob checksum), cached per checkpoint."""
+        st = self.superblock.state
+        if self.snapshot_store is None or st.op_checkpoint == 0:
+            return None
+        cached = self._sync_serve_cache
+        if cached is not None and cached[0] == st.op_checkpoint:
+            return cached
+        blob = self.snapshot_store.load(st.op_checkpoint)
+        if blob is None:
+            return None
+        self._sync_serve_cache = (st.op_checkpoint, blob, hdr.checksum(blob))
+        return self._sync_serve_cache
+
+    def _send_sync_chunk(self, peer: int, index: int) -> None:
+        entry = self._sync_blob()
+        if entry is None:
+            return
+        cp_op, blob, ident = entry
+        chunk_size = self.config.message_size_max - hdr.HEADER_SIZE
+        count = max(1, -(-len(blob) // chunk_size))
+        if index >= count:
+            return
+        sc = hdr.make(
+            Command.SYNC_CHECKPOINT, self.cluster,
+            view=self.view, replica=self.replica,
+            op=index, commit=count, timestamp=len(blob),
+            checkpoint_op=cp_op, parent=ident,
+        )
+        chunk = blob[index * chunk_size : (index + 1) * chunk_size]
+        self.bus.send_to_replica(peer, Message(sc, chunk).seal())
+
+    def on_request_sync_checkpoint(self, msg: Message) -> None:
+        self._send_sync_chunk(msg.header["replica"], msg.header["op"])
+
+    def _request_sync_chunks(self, retry: bool = False) -> None:
+        """Top up the request window to SYNC_CHUNKS_IN_FLIGHT outstanding
+        chunks; `retry` forgets in-flight requests that never landed (lost
+        or corrupt-dropped) so the timeout path re-issues them."""
+        s = self._sync
+        assert s is not None
+        if retry:
+            s["requested"] &= set(s["chunks"])
+        outstanding = len(s["requested"] - set(s["chunks"]))
+        budget = self.SYNC_CHUNKS_IN_FLIGHT - outstanding
+        if budget <= 0:
+            return
+        to_request = [
+            i for i in range(s["count"])
+            if i not in s["chunks"] and i not in s["requested"]
+        ][:budget]
+        for index in to_request:
+            s["requested"].add(index)
+            rq = hdr.make(
+                Command.REQUEST_SYNC_CHECKPOINT, self.cluster,
+                view=self.view, replica=self.replica,
+                op=index, checkpoint_op=s["checkpoint_op"],
+            )
+            self.bus.send_to_replica(s["peer"], Message(rq).seal())
+
+    def _sync_tick(self) -> None:
+        """Resume a stalled chunked sync (lost or corrupt chunks are simply
+        never delivered — Message.verify drops them — so re-request)."""
+        s = self._sync
+        if s is None:
+            return
+        if s["checkpoint_op"] <= max(self.commit_min, self.superblock.state.op_checkpoint):
+            self._sync = None  # caught up via WAL repair meanwhile
+            return
+        if self.tick_count - s["last_tick"] >= 2 * REPAIR_TIMEOUT:
+            s["last_tick"] = self.tick_count
+            self._request_sync_chunks(retry=True)
 
     def on_sync_checkpoint(self, msg: Message) -> None:
-        """Install a peer's checkpoint: reset the state machine to the
-        snapshot and resume WAL repair from there."""
+        """Accumulate chunked snapshot state; install when complete."""
         h = msg.header
         sync_op = h["checkpoint_op"]
         if sync_op <= self.commit_min or sync_op <= self.superblock.state.op_checkpoint:
             return
+        ident = h["parent"]
+        s = self._sync
+        if s is not None and (s["checkpoint_op"], s["ident"]) != (sync_op, ident):
+            # Competing transfer: prefer the newer checkpoint.
+            if sync_op < s["checkpoint_op"]:
+                return
+            s = None
+        if s is None:
+            s = self._sync = {
+                "checkpoint_op": sync_op, "ident": ident,
+                "count": h["commit"], "total": h["timestamp"],
+                "chunks": {}, "requested": set(),
+                "peer": h["replica"], "last_tick": self.tick_count,
+            }
+        index = h["op"]
+        if index < s["count"] and index not in s["chunks"]:
+            s["chunks"][index] = msg.body
+            # Only progress refreshes the stall timer: duplicate announces
+            # (the repair loop re-sends chunk 0 each repair tick) must not
+            # keep postponing the lost-chunk retry forever.
+            s["last_tick"] = self.tick_count
+        s["peer"] = h["replica"]
+        if len(s["chunks"]) < s["count"]:
+            self._request_sync_chunks()
+            return
+        blob = b"".join(s["chunks"][i] for i in range(s["count"]))
+        self._sync = None
+        if len(blob) != s["total"] or hdr.checksum(blob) != s["ident"]:
+            return  # torn/forged assembly — a retry will start fresh
+        self._install_sync_checkpoint(sync_op, blob)
+
+    def _install_sync_checkpoint(self, sync_op: int, blob: bytes) -> None:
+        """Install a peer's checkpoint: reset the state machine to the
+        snapshot and resume WAL repair from there."""
+        old_sm, old_clients = self.state_machine, self.clients
         self.state_machine = StateMachine(self.config, backend=self.sm_backend)
         # The client table is replicated state — it must exactly match the
         # installed checkpoint, so sessions from before the sync are dropped.
         self.clients = {}
-        self._load_snapshot(msg.body)
+        try:
+            self._load_snapshot(blob)
+        except Exception:
+            # Checksum-consistent but structurally malformed blob (corrupt
+            # store entry or forged ident): decoding must never crash the
+            # replica loop or leave half-installed state — restore and let a
+            # later sync attempt start fresh.
+            self.state_machine, self.clients = old_sm, old_clients
+            return
         self.commit_min = sync_op
         self.checksum_floor = sync_op
         self.op = max(self.op, sync_op)
@@ -823,7 +940,7 @@ class Replica:
         st.commit_min = sync_op
         st.commit_max = max(st.commit_max, sync_op)
         if self.snapshot_store is not None:
-            self.snapshot_store.save(sync_op, msg.body)
+            self.snapshot_store.save(sync_op, blob)
         self.superblock.checkpoint()
         if self.snapshot_store is not None:
             self.snapshot_store.prune(keep_op=sync_op)
@@ -1239,105 +1356,7 @@ class Replica:
         self.on_event("checkpoint", self)
 
     def _save_snapshot(self) -> bytes:
-        sm = self.state_machine
-        count = sm.account_count
-        dp, dpo, cp, cpo = sm._read_balances(np.arange(count, dtype=np.int64))
-        buf = _io.BytesIO()
-        np.savez(
-            buf,
-            account_count=np.int64(count),
-            acc_key_hi=sm.acc_key["hi"][:count], acc_key_lo=sm.acc_key["lo"][:count],
-            acc_ud128_lo=sm.acc_user_data_128_lo[:count],
-            acc_ud128_hi=sm.acc_user_data_128_hi[:count],
-            acc_ud64=sm.acc_user_data_64[:count], acc_ud32=sm.acc_user_data_32[:count],
-            acc_ledger=sm.acc_ledger[:count], acc_code=sm.acc_code[:count],
-            acc_flags=sm.acc_flags[:count], acc_ts=sm.acc_timestamp[:count],
-            bal_dp=dp, bal_dpo=dpo, bal_cp=cp, bal_cpo=cpo,
-            transfers=sm.transfer_log.scan(),
-            posted_keys=np.array(list(sm.posted.keys()), dtype=np.uint64),
-            posted_vals=np.array(list(sm.posted.values()), dtype=np.uint8),
-            history=np.array(
-                [
-                    (
-                        r.timestamp,
-                        r.dr_account_id & ((1 << 64) - 1), r.dr_account_id >> 64,
-                        r.dr_debits_pending, r.dr_debits_posted,
-                        r.dr_credits_pending, r.dr_credits_posted,
-                        r.cr_account_id & ((1 << 64) - 1), r.cr_account_id >> 64,
-                        r.cr_debits_pending, r.cr_debits_posted,
-                        r.cr_credits_pending, r.cr_credits_posted,
-                    )
-                    for r in sm.history
-                ],
-                dtype=object,
-            ) if sm.history else np.zeros((0,), dtype=object),
-            prepare_timestamp=np.uint64(sm.prepare_timestamp),
-            commit_timestamp=np.uint64(sm.commit_timestamp),
-            # Client table (reference client_sessions + client_replies zones).
-            client_table=np.array(
-                [
-                    (cid, s.session, s.request,
-                     s.reply.to_bytes() if s.reply is not None else b"")
-                    for cid, s in self.clients.items()
-                ],
-                dtype=object,
-            ) if self.clients else np.zeros((0,), dtype=object),
-        )
-        return buf.getvalue()
+        return snapshot.encode(self)
 
     def _load_snapshot(self, blob: bytes) -> None:
-        from tigerbeetle_tpu.lsm.store import pack_keys
-        from tigerbeetle_tpu.models.oracle import HistoryRow
-
-        z = np.load(_io.BytesIO(blob), allow_pickle=True)
-        sm = self.state_machine
-        count = int(z["account_count"])
-        sm.account_count = count
-        keys = pack_keys(z["acc_key_lo"], z["acc_key_hi"])
-        sm.acc_key[:count] = keys
-        sm.acc_user_data_128_lo[:count] = z["acc_ud128_lo"]
-        sm.acc_user_data_128_hi[:count] = z["acc_ud128_hi"]
-        sm.acc_user_data_64[:count] = z["acc_ud64"]
-        sm.acc_user_data_32[:count] = z["acc_ud32"]
-        sm.acc_ledger[:count] = z["acc_ledger"]
-        sm.acc_code[:count] = z["acc_code"]
-        sm.acc_flags[:count] = z["acc_flags"]
-        sm.acc_timestamp[:count] = z["acc_ts"]
-        sm.account_index.insert_batch(keys, np.arange(count, dtype=np.uint32))
-        sm._register_accounts(
-            np.arange(count, dtype=np.int32), z["acc_ledger"], z["acc_flags"],
-            np.ones(count, dtype=bool),
-        )
-        sm._write_balances(
-            np.arange(count, dtype=np.int32),
-            z["bal_dp"], z["bal_dpo"], z["bal_cp"], z["bal_cpo"],
-        )
-        transfers = z["transfers"]
-        if len(transfers):
-            transfers = transfers.view(types.TRANSFER_DTYPE) if transfers.dtype != types.TRANSFER_DTYPE else transfers
-            rows = sm.transfer_log.append_batch(transfers)
-            sm.transfer_index.insert_batch(
-                pack_keys(transfers["id_lo"], transfers["id_hi"]), rows
-            )
-        sm.posted = {
-            int(k): int(v) for k, v in zip(z["posted_keys"], z["posted_vals"])
-        }
-        for row in z["history"]:
-            sm.history.append(
-                HistoryRow(
-                    timestamp=int(row[0]),
-                    dr_account_id=int(row[1]) | (int(row[2]) << 64),
-                    dr_debits_pending=int(row[3]), dr_debits_posted=int(row[4]),
-                    dr_credits_pending=int(row[5]), dr_credits_posted=int(row[6]),
-                    cr_account_id=int(row[7]) | (int(row[8]) << 64),
-                    cr_debits_pending=int(row[9]), cr_debits_posted=int(row[10]),
-                    cr_credits_pending=int(row[11]), cr_credits_posted=int(row[12]),
-                )
-            )
-        sm.prepare_timestamp = int(z["prepare_timestamp"])
-        sm.commit_timestamp = int(z["commit_timestamp"])
-        for row in z["client_table"]:
-            sess = ClientSession(session=int(row[1]))
-            sess.request = int(row[2])
-            sess.reply = Message.from_bytes(row[3]) if len(row[3]) else None
-            self.clients[int(row[0])] = sess
+        snapshot.install(self, blob)

@@ -1,0 +1,203 @@
+"""Pickle-free checkpoint snapshots with fixed structured dtypes.
+
+The checkpoint blob is the TPU build's stand-in for the reference's
+checkpoint trailer (/root/reference/src/vsr/checkpoint_trailer.zig), which
+chunks free-set / client-session state into typed grid blocks. Every
+section here is a fixed structured numpy dtype serialized with np.savez and
+read back with ``allow_pickle=False`` — a peer-supplied snapshot body can
+never execute code (it previously could: object-dtype arrays forced
+``allow_pickle=True`` on load, i.e. remote code execution for any peer that
+could pass the body checksum).
+
+Sections:
+  accounts   — immutable per-account fields + exact u128 balances (lo/hi u64)
+  transfers  — wire-layout TRANSFER_DTYPE rows, commit order
+  posted     — pending-transfer fulfillment map (timestamp → u8)
+  history    — HISTORY_DTYPE rows (reference AccountHistoryGrooveValue,
+               state_machine.zig:275-292), u128 balances as u64 pairs
+  clients    — CLIENT_ENTRY_DTYPE rows + concatenated sealed reply messages
+               (reference client_sessions.zig replicated client table)
+"""
+
+from __future__ import annotations
+
+import io as _io
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+U64_MAX = (1 << 64) - 1
+
+# One AccountHistoryGrooveValue row; u128 values as (lo, hi) u64 pairs.
+HISTORY_DTYPE = np.dtype(
+    [("timestamp", "<u8")]
+    + [
+        (f"{side}_{field}_{half}", "<u8")
+        for side in ("dr", "cr")
+        for field in (
+            "account_id",
+            "debits_pending", "debits_posted",
+            "credits_pending", "credits_posted",
+        )
+        for half in ("lo", "hi")
+    ]
+)
+
+CLIENT_ENTRY_DTYPE = np.dtype(
+    [
+        ("client_lo", "<u8"), ("client_hi", "<u8"),
+        ("session", "<u8"),
+        ("request", "<u4"),
+        ("reply_len", "<u4"),
+    ]
+)
+
+
+def _split(v: int) -> Tuple[int, int]:
+    return v & U64_MAX, v >> 64
+
+
+def _join(lo, hi) -> int:
+    return int(lo) | (int(hi) << 64)
+
+
+def history_to_array(history) -> np.ndarray:
+    out = np.zeros(len(history), dtype=HISTORY_DTYPE)
+    for i, r in enumerate(history):
+        rec = out[i]
+        rec["timestamp"] = r.timestamp
+        for side in ("dr", "cr"):
+            for field in (
+                "account_id",
+                "debits_pending", "debits_posted",
+                "credits_pending", "credits_posted",
+            ):
+                lo, hi = _split(getattr(r, f"{side}_{field}"))
+                rec[f"{side}_{field}_lo"] = lo
+                rec[f"{side}_{field}_hi"] = hi
+    return out
+
+
+def history_from_array(arr: np.ndarray) -> List:
+    from tigerbeetle_tpu.models.oracle import HistoryRow
+
+    out = []
+    for rec in arr:
+        row = HistoryRow(timestamp=int(rec["timestamp"]))
+        for side in ("dr", "cr"):
+            for field in (
+                "account_id",
+                "debits_pending", "debits_posted",
+                "credits_pending", "credits_posted",
+            ):
+                setattr(
+                    row, f"{side}_{field}",
+                    _join(rec[f"{side}_{field}_lo"], rec[f"{side}_{field}_hi"]),
+                )
+        out.append(row)
+    return out
+
+
+def encode(replica) -> bytes:
+    """Serialize the replica's replicated state at its current commit point."""
+    sm = replica.state_machine
+    count = sm.account_count
+    dp, dpo, cp, cpo = sm._read_balances(np.arange(count, dtype=np.int64))
+
+    client_rows = np.zeros(len(replica.clients), dtype=CLIENT_ENTRY_DTYPE)
+    reply_blobs: List[bytes] = []
+    for i, (cid, sess) in enumerate(sorted(replica.clients.items())):
+        raw = sess.reply.to_bytes() if sess.reply is not None else b""
+        client_rows[i]["client_lo"], client_rows[i]["client_hi"] = _split(cid)
+        client_rows[i]["session"] = sess.session
+        client_rows[i]["request"] = sess.request
+        client_rows[i]["reply_len"] = len(raw)
+        reply_blobs.append(raw)
+
+    buf = _io.BytesIO()
+    np.savez(
+        buf,
+        version=np.uint32(2),
+        account_count=np.int64(count),
+        acc_key_hi=sm.acc_key["hi"][:count], acc_key_lo=sm.acc_key["lo"][:count],
+        acc_ud128_lo=sm.acc_user_data_128_lo[:count],
+        acc_ud128_hi=sm.acc_user_data_128_hi[:count],
+        acc_ud64=sm.acc_user_data_64[:count], acc_ud32=sm.acc_user_data_32[:count],
+        acc_ledger=sm.acc_ledger[:count], acc_code=sm.acc_code[:count],
+        acc_flags=sm.acc_flags[:count], acc_ts=sm.acc_timestamp[:count],
+        bal_dp=dp, bal_dpo=dpo, bal_cp=cp, bal_cpo=cpo,
+        transfers=sm.transfer_log.scan(),
+        posted_keys=np.array(sorted(sm.posted.keys()), dtype=np.uint64),
+        posted_vals=np.array(
+            [sm.posted[k] for k in sorted(sm.posted.keys())], dtype=np.uint8
+        ),
+        history=history_to_array(sm.history),
+        prepare_timestamp=np.uint64(sm.prepare_timestamp),
+        commit_timestamp=np.uint64(sm.commit_timestamp),
+        client_table=client_rows,
+        client_replies=np.frombuffer(b"".join(reply_blobs), dtype=np.uint8),
+    )
+    return buf.getvalue()
+
+
+def install(replica, blob: bytes) -> None:
+    """Install a snapshot into a freshly reset replica state machine.
+
+    Strictly ``allow_pickle=False``: a malformed blob raises (the caller
+    treats that as a failed sync / corrupt checkpoint), it never executes.
+    """
+    from tigerbeetle_tpu import types
+    from tigerbeetle_tpu.lsm.store import pack_keys
+    from tigerbeetle_tpu.vsr.header import Message
+    from tigerbeetle_tpu.vsr.replica import ClientSession
+
+    z = np.load(_io.BytesIO(blob), allow_pickle=False)
+    sm = replica.state_machine
+    count = int(z["account_count"])
+    sm.account_count = count
+    keys = pack_keys(z["acc_key_lo"], z["acc_key_hi"])
+    sm.acc_key[:count] = keys
+    sm.acc_user_data_128_lo[:count] = z["acc_ud128_lo"]
+    sm.acc_user_data_128_hi[:count] = z["acc_ud128_hi"]
+    sm.acc_user_data_64[:count] = z["acc_ud64"]
+    sm.acc_user_data_32[:count] = z["acc_ud32"]
+    sm.acc_ledger[:count] = z["acc_ledger"]
+    sm.acc_code[:count] = z["acc_code"]
+    sm.acc_flags[:count] = z["acc_flags"]
+    sm.acc_timestamp[:count] = z["acc_ts"]
+    sm.account_index.insert_batch(keys, np.arange(count, dtype=np.uint32))
+    sm._register_accounts(
+        np.arange(count, dtype=np.int32), z["acc_ledger"], z["acc_flags"],
+        np.ones(count, dtype=bool),
+    )
+    sm._write_balances(
+        np.arange(count, dtype=np.int32),
+        z["bal_dp"], z["bal_dpo"], z["bal_cp"], z["bal_cpo"],
+    )
+    transfers = z["transfers"]
+    if len(transfers):
+        if transfers.dtype != types.TRANSFER_DTYPE:
+            transfers = transfers.view(types.TRANSFER_DTYPE)
+        rows = sm.transfer_log.append_batch(transfers)
+        sm.transfer_index.insert_batch(
+            pack_keys(transfers["id_lo"], transfers["id_hi"]), rows
+        )
+    sm.posted = {
+        int(k): int(v) for k, v in zip(z["posted_keys"], z["posted_vals"])
+    }
+    sm.history = history_from_array(z["history"])
+    sm.prepare_timestamp = int(z["prepare_timestamp"])
+    sm.commit_timestamp = int(z["commit_timestamp"])
+
+    replies = z["client_replies"].tobytes()
+    offset = 0
+    clients: Dict[int, ClientSession] = {}
+    for rec in z["client_table"]:
+        sess = ClientSession(session=int(rec["session"]))
+        sess.request = int(rec["request"])
+        rlen = int(rec["reply_len"])
+        if rlen:
+            sess.reply = Message.from_bytes(replies[offset : offset + rlen])
+            offset += rlen
+        clients[_join(rec["client_lo"], rec["client_hi"])] = sess
+    replica.clients.update(clients)
