@@ -1,0 +1,269 @@
+"""LSM tier tests: grid/free set/EWAH, device-vs-host merge byte equality,
+durable tables + compaction, bounded-memory ingest, restart durability.
+
+Reference strategy: per-component randomized tests against a model
+(fuzz_tests.zig registry: lsm_tree, vsr_free_set, ewah), plus the storage-
+determinism discipline (byte-identical device/host merges — the north-star
+acceptance bar for the compaction kernel).
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.constants import TEST_MIN
+from tigerbeetle_tpu.io import ewah
+from tigerbeetle_tpu.io.grid import FreeSet, Grid, MemGrid
+from tigerbeetle_tpu.io.storage import FileStorage, MemStorage
+from tigerbeetle_tpu.lsm.log import DurableLog
+from tigerbeetle_tpu.lsm.store import NOT_FOUND, pack_keys
+from tigerbeetle_tpu.lsm.tree import DurableIndex, _keys_to_limbs, _limbs_to_keys
+from tigerbeetle_tpu.ops import merge as merge_ops
+
+
+class TestEwah:
+    @pytest.mark.parametrize("n", [1, 63, 64, 65, 1000, 100_000])
+    def test_roundtrip_random(self, n):
+        rng = np.random.default_rng(n)
+        bits = rng.random(n) < 0.05
+        words = ewah.bitset_to_words(bits)
+        dec = ewah.decode(ewah.encode(words), len(words))
+        assert (dec == words).all()
+        assert (ewah.words_to_bitset(dec, n) == bits).all()
+
+    def test_uniform_runs_compress(self):
+        bits = np.zeros(1 << 20, dtype=bool)
+        bits[5] = True  # one literal word among 16384
+        words = ewah.bitset_to_words(bits)
+        enc = ewah.encode(words)
+        assert len(enc) < 100  # two markers + one literal
+        assert (ewah.decode(enc, len(words)) == words).all()
+
+
+class TestFreeSet:
+    def test_acquire_release_staged(self):
+        fs = FreeSet(64)
+        a = [fs.acquire() for _ in range(10)]
+        assert fs.free_count == 54
+        fs.stage_release(a[3])
+        # Staged: still unavailable to acquire...
+        assert not fs.free[a[3]]
+        # ...but encoded as free (post-checkpoint view).
+        restored = FreeSet(64)
+        restored.restore(fs.encode())
+        assert restored.free[a[3]]
+        assert restored.free_count == 55
+        fs.commit_staged()
+        assert fs.free[a[3]]
+
+    def test_grid_checksum_detects_corruption(self):
+        storage = MemStorage(1 << 20, seed=3)
+        g = Grid(storage, 0, 16, 4096)
+        b = g.write_block(b"hello world" * 50)
+        storage.sync()
+        assert g.read_block(b) == b"hello world" * 50
+        g.drop_cache()
+        storage.corrupt_sector(b * 4096 // 4096)
+        with pytest.raises(IOError):
+            g.read_block(b)
+
+
+class TestMergeKernel:
+    @pytest.mark.parametrize("seed", range(5))
+    def test_device_host_byte_equality(self, seed):
+        rng = np.random.default_rng(seed)
+        n, m = int(rng.integers(1, 400)), int(rng.integers(1, 400))
+        ka = np.sort(rng.integers(0, 1 << 48, n).astype(np.uint64))
+        kb = np.sort(rng.integers(0, 1 << 48, m).astype(np.uint64))
+        a_keys = pack_keys(ka, (ka >> np.uint64(13)).astype(np.uint64))
+        b_keys = pack_keys(kb, (kb >> np.uint64(13)).astype(np.uint64))
+        a_keys = np.sort(a_keys, kind="stable")
+        b_keys = np.sort(b_keys, kind="stable")
+        va = rng.integers(0, 1 << 31, n).astype(np.uint32)
+        vb = rng.integers(0, 1 << 31, m).astype(np.uint32)
+
+        hk, hv = merge_ops.merge_host(a_keys, va, b_keys, vb)
+        dk_limbs, dv = merge_ops.merge_device(
+            _keys_to_limbs(a_keys), va, _keys_to_limbs(b_keys), vb
+        )
+        dk = _limbs_to_keys(dk_limbs)
+        assert hk.tobytes() == dk.tobytes()
+        assert hv.tobytes() == dv.tobytes()
+
+    def test_stability_duplicates_across_runs(self):
+        # Equal keys: A-side (older) values must precede B-side values.
+        ka = pack_keys(np.array([5, 5, 9], dtype=np.uint64), np.zeros(3, dtype=np.uint64))
+        kb = pack_keys(np.array([5, 9, 9], dtype=np.uint64), np.zeros(3, dtype=np.uint64))
+        va = np.array([1, 2, 3], dtype=np.uint32)
+        vb = np.array([10, 20, 30], dtype=np.uint32)
+        hk, hv = merge_ops.merge_host(ka, va, kb, vb)
+        assert list(hv) == [1, 2, 10, 3, 20, 30]
+        dk, dv = merge_ops.merge_device(_keys_to_limbs(ka), va, _keys_to_limbs(kb), vb)
+        assert list(dv) == [1, 2, 10, 3, 20, 30]
+
+
+class TestDurableIndex:
+    def _rand_index(self, backend="numpy", n=30_000, seed=7):
+        rng = np.random.default_rng(seed)
+        grid = MemGrid(block_count=8192, block_size=4096)
+        idx = DurableIndex(grid, unique=True, memtable_max=512, growth=4, backend=backend)
+        lo = rng.permutation(np.arange(1, n + 1, dtype=np.uint64))
+        hi = rng.integers(0, 1 << 32, n).astype(np.uint64)
+        vals = np.arange(n, dtype=np.uint32)
+        for i in range(0, n, 777):
+            idx.insert_batch(pack_keys(lo[i : i + 777], hi[i : i + 777]), vals[i : i + 777])
+        return grid, idx, lo, hi, vals
+
+    def test_lookup_after_compactions(self):
+        grid, idx, lo, hi, vals = self._rand_index()
+        assert sum(len(l) for l in idx.levels) > 1  # multi-level shape
+        q = pack_keys(lo[::11], hi[::11])
+        assert (idx.lookup_batch(q) == vals[::11]).all()
+        absent = pack_keys(
+            np.array([10**15], dtype=np.uint64), np.array([7], dtype=np.uint64)
+        )
+        assert idx.lookup_batch(absent)[0] == NOT_FOUND
+
+    def test_checkpoint_restore_exact(self):
+        grid, idx, lo, hi, vals = self._rand_index()
+        manifest = idx.checkpoint()
+        idx2 = DurableIndex(grid, unique=True, memtable_max=512, growth=4)
+        idx2.restore(manifest)
+        q = pack_keys(lo[::17], hi[::17])
+        assert (idx2.lookup_batch(q) == vals[::17]).all()
+        assert idx2.count == idx.count
+
+    def test_device_and_host_compaction_same_tables(self):
+        """The north-star bar: compaction through the device merge kernel
+        produces byte-identical table contents to the host merge."""
+        _, idx_h, lo, hi, vals = self._rand_index(backend="numpy")
+        _, idx_d, _, _, _ = self._rand_index(backend="jax")
+
+        def dump(idx):
+            parts = []
+            for level in idx.levels:
+                for t in level:
+                    for f in idx._table_fences(t):
+                        k, v = idx._read_data_block(int(f["block"]), int(f["count"]))
+                        parts.append((k.tobytes(), v.tobytes()))
+            return parts
+
+        assert dump(idx_h) == dump(idx_d)
+
+    def test_duplicate_key_range(self):
+        grid = MemGrid(block_count=4096, block_size=4096)
+        nu = DurableIndex(grid, unique=False, memtable_max=128, growth=3)
+        keys_lo = np.repeat(np.arange(1, 40, dtype=np.uint64), 100)
+        rows = np.arange(3900, dtype=np.uint32)
+        for i in range(0, 3900, 250):
+            n = min(250, 3900 - i)
+            nu.insert_batch(
+                pack_keys(keys_lo[i : i + n], np.zeros(n, dtype=np.uint64)),
+                rows[i : i + n],
+            )
+        for k in (1, 17, 39):
+            key = pack_keys(
+                np.array([k], dtype=np.uint64), np.zeros(1, dtype=np.uint64)
+            )[0]
+            got = nu.lookup_range(key)
+            want = np.sort(rows[keys_lo == k])
+            assert (got == want).all()
+
+    def test_free_space_reclaimed_after_commit(self):
+        grid, idx, *_ = self._rand_index()
+        # Eager mode (defer_releases=False): compaction frees immediately,
+        # so allocated blocks ≈ live tables only.
+        live = sum(
+            len(idx._table_fences(t)) + 1 for level in idx.levels for t in level
+        )
+        allocated = grid.block_count - grid.free_set.free_count
+        assert allocated == live + (1 if idx._mem_count else 0) * 0
+
+
+class TestDurableLog:
+    def test_append_gather_scan(self):
+        grid = MemGrid(block_count=2048, block_size=4096)
+        log = DurableLog(grid, types.TRANSFER_DTYPE)
+        recs = np.zeros(5000, dtype=types.TRANSFER_DTYPE)
+        recs["id_lo"] = np.arange(5000)
+        log.append_batch(recs[:1234])
+        log.append_batch(recs[1234:])
+        got = log.gather(np.array([0, 1233, 1234, 4999, 4321]))
+        assert list(got["id_lo"]) == [0, 1233, 1234, 4999, 4321]
+        total = sum(len(r) for _, r in log.scan_range(0, log.count))
+        assert total == 5000
+        window = list(log.scan_range(100, 164))
+        assert sum(len(r) for _, r in window) == 64
+
+    def test_restore(self):
+        grid = MemGrid(block_count=2048, block_size=4096)
+        log = DurableLog(grid, types.TRANSFER_DTYPE)
+        recs = np.zeros(500, dtype=types.TRANSFER_DTYPE)
+        recs["id_lo"] = np.arange(500)
+        log.append_batch(recs)
+        blocks, tail = log.checkpoint()
+        log2 = DurableLog(grid, types.TRANSFER_DTYPE)
+        log2.restore(blocks, tail)
+        assert log2.count == 500
+        assert (log2.export_all()["id_lo"] == np.arange(500)).all()
+
+
+class TestBoundedIngest:
+    def test_ram_bounded_file_backed_ingest(self, tmp_path):
+        """Sustained ingest keeps only O(memtable + cache) state in RAM —
+        the tail block, bounded index memtables, and the grid LRU; the rest
+        lives in the file (VERDICT r2 task 1 done-bar, scaled for CI)."""
+        from tigerbeetle_tpu.constants import Config
+        from tigerbeetle_tpu.models.state_machine import StateMachine
+
+        cfg = Config(
+            name="ingest", accounts_max=1 << 10, transfers_max=1 << 20,
+            lsm_block_size=1 << 14, grid_block_count=1 << 12,  # 64 MiB
+            index_memtable_rows=4096,
+        )
+        path = os.path.join(tmp_path, "grid.dat")
+        storage = FileStorage(path, size=cfg.grid_block_count * cfg.lsm_block_size,
+                              create=True)
+        grid = Grid(storage, 0, cfg.grid_block_count, cfg.lsm_block_size,
+                    cache_blocks=16)
+        sm = StateMachine(cfg, backend="numpy", grid=grid)
+
+        accs = np.zeros(64, dtype=types.ACCOUNT_DTYPE)
+        accs["id_lo"] = np.arange(1, 65)
+        accs["ledger"] = 1
+        accs["code"] = 1
+        sm.create_accounts(accs)
+
+        total = 120_000
+        bs = 8000
+        rng = np.random.default_rng(5)
+        for start in range(0, total, bs):
+            recs = np.zeros(bs, dtype=types.TRANSFER_DTYPE)
+            recs["id_lo"] = 1000 + start + np.arange(bs)
+            dr = rng.integers(1, 65, bs)
+            cr = (dr % 64) + 1
+            recs["debit_account_id_lo"] = dr
+            recs["credit_account_id_lo"] = cr
+            recs["amount_lo"] = 1
+            recs["ledger"] = 1
+            recs["code"] = 1
+            res = sm.create_transfers(recs)
+            assert len(res) == 0
+
+        # RAM invariants: bounded tail, bounded memtables, bounded cache.
+        assert sm.transfer_log._tail_len < sm.transfer_log.records_per_block
+        assert sm.transfer_index._mem_count < cfg.index_memtable_rows
+        assert sm.account_rows._mem_count < cfg.index_memtable_rows
+        assert len(grid._cache) <= 16
+        # Everything is durably addressable: spot-check lookups + queries.
+        got = sm.lookup_transfers(
+            np.array([1000, 1000 + total - 1], dtype=np.uint64),
+            np.zeros(2, dtype=np.uint64),
+        )
+        assert len(got) == 2
+        page = sm.get_account_transfers(account_id=7, limit=50)
+        assert len(page) == 50
+        storage.close()

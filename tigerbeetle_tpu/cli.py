@@ -34,7 +34,9 @@ def cmd_format(args) -> int:
 
     config = config_by_name(args.config)
     zone = Zone.for_config(
-        config.journal_slot_count, config.message_size_max, config.clients_max
+        config.journal_slot_count, config.message_size_max, config.clients_max,
+        grid_block_count=config.grid_block_count,
+        grid_block_size=config.lsm_block_size,
     )
     storage = FileStorage(args.path, size=zone.total_size, create=True)
     Replica.format(storage, zone, args.cluster, args.replica, args.replica_count)
@@ -91,7 +93,9 @@ def cmd_start(args) -> int:
 
     config = config_by_name(args.config)
     zone = Zone.for_config(
-        config.journal_slot_count, config.message_size_max, config.clients_max
+        config.journal_slot_count, config.message_size_max, config.clients_max,
+        grid_block_count=config.grid_block_count,
+        grid_block_size=config.lsm_block_size,
     )
     addresses = parse_addresses(args.addresses)
     storage = FileStorage(args.path)

@@ -69,10 +69,24 @@ class Config:
     # Wire/disk: max message = header + batch_max records (reference
     # message_header.zig:70; smaller in test presets so WAL files stay tiny).
     message_size_max: int = MESSAGE_SIZE_MAX
+    # LSM grid geometry (reference config.zig block_size + grid sizing
+    # flags): lsm_block_size × grid_block_count bounds the durable LSM
+    # tier; files are sparse so production reserves address space cheaply.
+    lsm_block_size: int = 1 << 18  # 256 KiB
+    grid_block_count: int = 1 << 15  # × 256 KiB = 8 GiB
+    # Transfer-id / account-index memtable rows before a level-0 flush.
+    index_memtable_rows: int = 1 << 17
 
 
 PRODUCTION = Config()
-DEVELOPMENT = Config(name="development", accounts_max=1 << 18, transfers_max=1 << 20)
+DEVELOPMENT = Config(
+    name="development",
+    accounts_max=1 << 18,
+    transfers_max=1 << 20,
+    lsm_block_size=1 << 16,
+    grid_block_count=1 << 13,  # 512 MiB
+    index_memtable_rows=1 << 14,
+)
 TEST_MIN = Config(
     name="test_min",
     accounts_max=1 << 10,
@@ -84,6 +98,9 @@ TEST_MIN = Config(
     checkpoint_interval=16,
     state_runs_max=2,
     message_size_max=HEADER_SIZE + 64 * 128,
+    lsm_block_size=1 << 12,  # 4 KiB
+    grid_block_count=1 << 12,  # 16 MiB
+    index_memtable_rows=512,
 )
 
 

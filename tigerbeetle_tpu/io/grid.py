@@ -1,0 +1,231 @@
+"""Grid: write-once block storage + free set over the data file's grid zone.
+
+The durable home of the LSM tier (reference /root/reference/src/vsr/
+grid.zig:38 + free_set.zig:20-45, radically simplified for a single-writer
+host runtime): fixed-size blocks addressed by index, each sealed with a
+checksum header; a numpy-bitset free set persisted EWAH-compressed
+(io/ewah.py). Blocks are write-once between acquire and release — a block's
+content never changes while referenced, so readers may cache by address
+(the block cache below is the set-associative-cache analog, reference
+set_associative_cache.zig:15, as an LRU over block indices).
+
+Checkpoint contract: callers persist `free_set_encode()` output (plus their
+own manifests) in the checkpoint snapshot; `free_set_restore()` rewinds the
+allocation state on recovery, which implicitly releases blocks acquired
+after the checkpoint (write-once + rewind = crash consistency without a
+journal for the grid).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+from tigerbeetle_tpu.io import ewah
+from tigerbeetle_tpu.vsr.header import checksum as _checksum
+
+BLOCK_HEADER_SIZE = 32
+_BLOCK_HEADER_DTYPE = np.dtype(
+    [
+        ("checksum_lo", "<u8"), ("checksum_hi", "<u8"),
+        ("size", "<u4"),  # payload bytes
+        ("block_type", "<u4"),
+        ("reserved", "<u8"),
+    ]
+)
+assert _BLOCK_HEADER_DTYPE.itemsize == BLOCK_HEADER_SIZE
+
+
+class FreeSet:
+    """Bitset allocator for grid blocks (reference free_set.zig).
+
+    True = free. Acquisition scans from a cursor for determinism (the
+    reference's reserve/acquire protocol collapses to sequential acquire in
+    a single-writer host runtime).
+    """
+
+    def __init__(self, block_count: int) -> None:
+        self.free = np.ones(block_count, dtype=bool)
+        self._cursor = 0
+        # Frees staged until the next checkpoint commits (write-once per
+        # checkpoint epoch): blocks referenced by the last durable
+        # checkpoint must not be reused before a newer checkpoint lands,
+        # or crash recovery could rewind to a manifest whose blocks were
+        # overwritten.
+        self._staged: list[int] = []
+
+    @property
+    def free_count(self) -> int:
+        return int(self.free.sum())
+
+    def acquire(self) -> int:
+        n = len(self.free)
+        ix = np.argmax(self.free[self._cursor :])
+        if self.free[self._cursor + ix]:
+            got = self._cursor + int(ix)
+        else:
+            ix = np.argmax(self.free)
+            if not self.free[ix]:
+                raise RuntimeError("grid full: no free blocks")
+            got = int(ix)
+        self.free[got] = False
+        self._cursor = got + 1 if got + 1 < n else 0
+        return got
+
+    def release(self, index: int) -> None:
+        assert not self.free[index], f"double release of block {index}"
+        self.free[index] = True
+
+    def stage_release(self, index: int) -> None:
+        assert not self.free[index], f"double release of block {index}"
+        self._staged.append(index)
+
+    def commit_staged(self) -> None:
+        """Apply staged frees — call only after the superseding checkpoint
+        is durable."""
+        for i in self._staged:
+            self.free[i] = True
+        self._staged = []
+
+    def encode(self) -> bytes:
+        """Snapshot the allocation state as it will stand once this
+        checkpoint is durable (staged frees applied)."""
+        bits = self.free.copy()
+        if self._staged:
+            bits[np.array(self._staged, dtype=np.int64)] = True
+        return ewah.encode(ewah.bitset_to_words(bits))
+
+    def restore(self, data: bytes) -> None:
+        n = len(self.free)
+        words = ewah.decode(data, -(-n // ewah.WORD_BITS))
+        self.free = ewah.words_to_bitset(words, n)
+        self._staged = []
+        self._cursor = 0
+
+
+class Grid:
+    """Checksummed write-once blocks over a storage zone range.
+
+    `storage` is any object with read/write/sync (io/storage.py); offsets
+    are absolute. A small LRU cache holds decoded payloads of hot blocks
+    (index blocks, tail data blocks).
+    """
+
+    def __init__(
+        self,
+        storage,
+        offset: int,
+        block_count: int,
+        block_size: int,
+        cache_blocks: int = 64,
+        defer_releases: bool = False,
+    ) -> None:
+        assert block_size > BLOCK_HEADER_SIZE
+        self.storage = storage
+        self.offset = offset
+        self.block_size = block_size
+        self.block_count = block_count
+        # Checkpointing owners (the replica) defer frees until the
+        # superseding checkpoint is durable; standalone users free eagerly.
+        self.defer_releases = defer_releases
+        self.free_set = FreeSet(block_count)
+        self._cache: OrderedDict[int, bytes] = OrderedDict()
+        self._cache_blocks = cache_blocks
+        self.reads = 0
+        self.writes = 0
+        self.cache_hits = 0
+
+    @property
+    def payload_max(self) -> int:
+        return self.block_size - BLOCK_HEADER_SIZE
+
+    def _addr(self, index: int) -> int:
+        assert 0 <= index < self.block_count
+        return self.offset + index * self.block_size
+
+    def write_block(self, payload: bytes, block_type: int = 0) -> int:
+        """Acquire a free block, write header+payload, return its index.
+
+        No sync — callers batch-sync at durability points (checkpoint);
+        write-once + free-set rewind keeps crashes consistent.
+        """
+        assert len(payload) <= self.payload_max, (
+            f"payload {len(payload)} > {self.payload_max}"
+        )
+        index = self.free_set.acquire()
+        head = np.zeros((), dtype=_BLOCK_HEADER_DTYPE)
+        head["size"] = len(payload)
+        head["block_type"] = block_type
+        c = _checksum(payload)
+        head["checksum_lo"] = c & ((1 << 64) - 1)
+        head["checksum_hi"] = c >> 64
+        self.storage.write(self._addr(index), head.tobytes() + payload)
+        self.writes += 1
+        self._cache_put(index, bytes(payload))
+        return index
+
+    def read_block(self, index: int) -> bytes:
+        """Return the payload; raises on checksum mismatch (corrupt block)."""
+        cached = self._cache.get(index)
+        if cached is not None:
+            self._cache.move_to_end(index)
+            self.cache_hits += 1
+            return cached
+        raw = self.storage.read(self._addr(index), self.block_size)
+        self.reads += 1
+        head = np.frombuffer(raw[:BLOCK_HEADER_SIZE], dtype=_BLOCK_HEADER_DTYPE)[0]
+        size = int(head["size"])
+        payload = raw[BLOCK_HEADER_SIZE : BLOCK_HEADER_SIZE + size]
+        want = int(head["checksum_lo"]) | (int(head["checksum_hi"]) << 64)
+        if size > self.payload_max or _checksum(payload) != want:
+            raise IOError(f"grid block {index} corrupt")
+        self._cache_put(index, payload)
+        return payload
+
+    def release(self, index: int) -> None:
+        if self.defer_releases:
+            self.free_set.stage_release(index)
+        else:
+            self.free_set.release(index)
+        self._cache.pop(index, None)
+
+    def commit_releases(self) -> None:
+        self.free_set.commit_staged()
+
+    def _cache_put(self, index: int, payload: bytes) -> None:
+        self._cache[index] = payload
+        self._cache.move_to_end(index)
+        while len(self._cache) > self._cache_blocks:
+            self._cache.popitem(last=False)
+
+    def drop_cache(self) -> None:
+        self._cache.clear()
+
+
+class MemGrid(Grid):
+    """Grid over a lazy in-memory page map (no Zone needed) — the default
+    backing for a StateMachine constructed without durable storage (tests,
+    benchmarks, the simulator's non-crash paths). Lazy so a production-
+    sized grid (GiBs of address space) costs only what is written."""
+
+    class _Buf:
+        """Sparse write-granularity page store; the grid only ever writes a
+        whole block at its base offset and reads whole blocks back."""
+
+        def __init__(self) -> None:
+            self.pages: dict[int, bytes] = {}
+
+        def read(self, offset: int, size: int) -> bytes:
+            data = self.pages.get(offset, b"")
+            return data[:size].ljust(size, b"\x00")
+
+        def write(self, offset: int, data: bytes) -> None:
+            self.pages[offset] = bytes(data)
+
+        def sync(self) -> None:
+            pass
+
+    def __init__(self, block_count: int, block_size: int, cache_blocks: int = 64) -> None:
+        super().__init__(MemGrid._Buf(), 0, block_count, block_size, cache_blocks)

@@ -31,10 +31,20 @@ class Zone:
     wal_prepares_size: int
     client_replies_offset: int
     client_replies_size: int
+    grid_offset: int = 0
+    grid_size: int = 0
+    grid_block_size: int = 0
+
+    @property
+    def grid_block_count(self) -> int:
+        return self.grid_size // self.grid_block_size if self.grid_block_size else 0
 
     @property
     def total_size(self) -> int:
-        return self.client_replies_offset + self.client_replies_size
+        return max(
+            self.client_replies_offset + self.client_replies_size,
+            self.grid_offset + self.grid_size,
+        )
 
     @staticmethod
     def for_config(
@@ -43,6 +53,8 @@ class Zone:
         clients_max: int,
         superblock_copies: int = 4,
         superblock_copy_size: int = SECTOR_SIZE,
+        grid_block_count: int = 0,
+        grid_block_size: int = 0,
     ) -> "Zone":
         sb_size = superblock_copies * superblock_copy_size
         wh_size = journal_slot_count * HEADER_SIZE
@@ -53,11 +65,15 @@ class Zone:
         wh_off = sb_off + sb_size
         wp_off = wh_off + wh_size
         cr_off = wp_off + wp_size
+        gr_off = cr_off + cr_size
+        gr_off = -(-gr_off // SECTOR_SIZE) * SECTOR_SIZE
         return Zone(
             superblock_offset=sb_off, superblock_size=sb_size,
             wal_headers_offset=wh_off, wal_headers_size=wh_size,
             wal_prepares_offset=wp_off, wal_prepares_size=wp_size,
             client_replies_offset=cr_off, client_replies_size=cr_size,
+            grid_offset=gr_off, grid_size=grid_block_count * grid_block_size,
+            grid_block_size=grid_block_size,
         )
 
 

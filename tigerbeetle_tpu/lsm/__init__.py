@@ -1,11 +1,16 @@
-"""Host-side LSM-shaped storage: sorted-run indexes and the transfer log.
+"""The LSM tier: durable grid-backed tables, indexes, and the object log.
 
-The reference's LSM forest (/root/reference/src/lsm/) is a disk-backed tree
-of sorted runs per groove. In the TPU build the mutable hot state (account
-balances) lives on-device (ops/commit.py); the host keeps the reference's
-*index* role — id → slot/row maps and secondary indexes — as vectorized
-sorted runs with geometric merging (the same memtable → immutable-run →
-leveled-merge shape as lsm/tree.zig, without the disk format yet).
+Mirrors the reference's LSM forest (/root/reference/src/lsm/) TPU-first:
+  - lsm/tree.py   — DurableIndex: sorted tables on grid blocks (index block
+                    + data blocks), leveled compaction streamed through the
+                    device merge kernel (ops/merge.py).
+  - lsm/log.py    — DurableLog: append-only object store (commit order ==
+                    timestamp key order, so the object tree needs no sort).
+  - lsm/store.py  — U128Index: the in-RAM sorted-run index (account id →
+                    slot; bounded by accounts_max) + pack_keys helpers.
+Backed by io/grid.py (write-once checksummed blocks + EWAH free set).
 """
 
-from tigerbeetle_tpu.lsm.store import U128Index, TransferLog  # noqa: F401
+from tigerbeetle_tpu.lsm.log import DurableLog  # noqa: F401
+from tigerbeetle_tpu.lsm.store import KEY_DTYPE, NOT_FOUND, U128Index, pack_keys  # noqa: F401
+from tigerbeetle_tpu.lsm.tree import DurableIndex  # noqa: F401

@@ -1,11 +1,9 @@
-"""Sorted-run u128 → u32 index and the append-only transfer log.
+"""In-RAM sorted-run u128 → u32 index (account id → device slot).
 
-Mirrors the reference LSM tree shape (/root/reference/src/lsm/tree.zig:
-mutable memtable → immutable runs → merged levels) with numpy-vectorized
-batch operations: inserts append to a memtable; when it fills, it is sorted
-into an immutable run; when runs pile up they are merged (np stable sort of
-the concatenation — the host analog of compaction.zig's k-way merge; the
-Pallas streaming-merge kernel replaces this for device-resident runs).
+The RAM-resident sibling of lsm/tree.py's DurableIndex: same memtable →
+immutable-run → merge shape (reference lsm/tree.zig), but bounded by
+accounts_max so it never spills — the account id → slot map is read on
+every batch's prefetch and stays hot.
 
 Keys are u128 as structured (hi, lo) u64 pairs — numpy's structured compare
 gives exact lexicographic == numeric u128 order (no byte-string trailing-NUL
@@ -15,7 +13,7 @@ batches), matching the reference's prefetch design (groove.zig:644-909).
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 import numpy as np
 
@@ -96,44 +94,3 @@ class U128Index:
 
     def contains_any(self, keys: np.ndarray) -> bool:
         return bool(np.any(self.lookup_batch(keys) != NOT_FOUND))
-
-
-class TransferLog:
-    """Append-only columnar log of committed transfers, in commit order.
-
-    Row index == insertion order; transfer timestamps are strictly
-    increasing with row (the reference's object tree is keyed by timestamp,
-    groove.zig:138 — commit order IS timestamp order). Records are stored as
-    the wire-layout structured dtype so lookups return byte-exact rows.
-    """
-
-    def __init__(self, dtype: np.dtype) -> None:
-        self.dtype = dtype
-        self._chunks: List[np.ndarray] = []
-        self._consolidated: Optional[np.ndarray] = None
-        self.count = 0
-
-    def append_batch(self, records: np.ndarray) -> np.ndarray:
-        """Append (k,) structured records; returns their row indices."""
-        rows = np.arange(self.count, self.count + len(records), dtype=np.uint32)
-        if len(records):
-            self._chunks.append(records.copy())
-            self._consolidated = None
-            self.count += len(records)
-        return rows
-
-    def _all(self) -> np.ndarray:
-        if self._consolidated is None:
-            if self._chunks:
-                self._consolidated = np.concatenate(self._chunks)
-                self._chunks = [self._consolidated]
-            else:
-                self._consolidated = np.zeros(0, dtype=self.dtype)
-        return self._consolidated
-
-    def gather(self, rows: np.ndarray) -> np.ndarray:
-        return self._all()[np.asarray(rows, dtype=np.int64)]
-
-    def scan(self) -> np.ndarray:
-        """Full columnar view for vectorized range/filter queries."""
-        return self._all()

@@ -1,0 +1,535 @@
+"""Durable LSM index: sorted table files on grid blocks + leveled compaction.
+
+The TPU-first re-design of the reference's tree/table/compaction stack
+(/root/reference/src/lsm/tree.zig, table.zig:43-60, compaction.zig:280):
+
+  - A *table* is one index block + N data blocks of sorted (u128 key, u32
+    value) entries, all checksummed grid blocks (io/grid.py). The index
+    block holds per-data-block key fences — the analog of table.zig's index
+    block — so point lookups read exactly one data block.
+  - The *memtable* is unsorted appended batches (vectorized inserts only,
+    matching the prefetch-batch design, groove.zig:644-909); it flushes as a
+    sorted level-0 table.
+  - *Compaction* merges a full level into the next when it exceeds the
+    growth factor, streamed block-by-block through the merge kernel
+    (ops/merge.py — device binary-search merge on the jax backend, byte-
+    identical numpy merge on the host backend). Memory stays O(block), not
+    O(level): the streaming cursor logic here plays the role of the
+    reference's k-way merge iterator pacing (k_way_merge.zig:8).
+
+Free-space discipline: replaced tables are released to the grid free set,
+which stages frees until the next checkpoint commits (write-once per
+checkpoint epoch — reference grid.zig semantics), so crash recovery can
+always rewind to the last durable manifest.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from tigerbeetle_tpu.io.grid import Grid
+from tigerbeetle_tpu.lsm.store import KEY_DTYPE, NOT_FOUND
+
+ENTRY_SIZE = KEY_DTYPE.itemsize + 4  # key + u32 value
+
+# Per-data-block fence in the index block.
+INDEX_ENTRY_DTYPE = np.dtype(
+    [
+        ("first_hi", "<u8"), ("first_lo", "<u8"),
+        ("last_hi", "<u8"), ("last_lo", "<u8"),
+        ("block", "<u4"),
+        ("count", "<u4"),
+    ]
+)
+
+# One table's row in a persisted manifest.
+MANIFEST_DTYPE = np.dtype(
+    [
+        ("level", "<u4"),
+        ("index_block", "<u4"),
+        ("count", "<u8"),
+        ("min_hi", "<u8"), ("min_lo", "<u8"),
+        ("max_hi", "<u8"), ("max_lo", "<u8"),
+    ]
+)
+
+BLOCK_TYPE_DATA = 1
+BLOCK_TYPE_INDEX = 2
+
+
+def _keys_to_limbs(keys: np.ndarray) -> np.ndarray:
+    """KEY_DTYPE (hi, lo) → (n, 4) u32 little-endian limbs for the device."""
+    out = np.empty((len(keys), 4), dtype=np.uint32)
+    lo = keys["lo"]
+    hi = keys["hi"]
+    out[:, 0] = lo & 0xFFFFFFFF
+    out[:, 1] = lo >> np.uint64(32)
+    out[:, 2] = hi & 0xFFFFFFFF
+    out[:, 3] = hi >> np.uint64(32)
+    return out
+
+
+def _limbs_to_keys(limbs: np.ndarray) -> np.ndarray:
+    out = np.empty(len(limbs), dtype=KEY_DTYPE)
+    l64 = limbs.astype(np.uint64)
+    out["lo"] = l64[:, 0] | (l64[:, 1] << np.uint64(32))
+    out["hi"] = l64[:, 2] | (l64[:, 3] << np.uint64(32))
+    return out
+
+
+@dataclass
+class TableInfo:
+    """In-memory descriptor of one on-disk table (manifest.zig TableInfo)."""
+
+    index_block: int
+    count: int
+    key_min: Tuple[int, int]  # (hi, lo)
+    key_max: Tuple[int, int]
+
+    # Decoded index entries, lazily cached (the index block itself also sits
+    # in the grid's LRU, this just skips re-parsing).
+    _fences: Optional[np.ndarray] = None
+
+
+class _TableReader:
+    """Sequential block cursor over a table (compaction input stream)."""
+
+    def __init__(self, tree: "DurableIndex", table: TableInfo) -> None:
+        self.tree = tree
+        self.fences = tree._table_fences(table)
+        self.pos = 0
+
+    def exhausted(self) -> bool:
+        return self.pos >= len(self.fences)
+
+    def next_block(self) -> Tuple[np.ndarray, np.ndarray]:
+        f = self.fences[self.pos]
+        self.pos += 1
+        return self.tree._read_data_block(int(f["block"]), int(f["count"]))
+
+
+class _MergeStream:
+    """Buffered stream over a sequence of tables (oldest-precedence side)."""
+
+    def __init__(self, tree: "DurableIndex", tables: List[TableInfo]) -> None:
+        self.readers = [_TableReader(tree, t) for t in tables]
+        self.keys = np.zeros(0, dtype=KEY_DTYPE)
+        self.vals = np.zeros(0, dtype=np.uint32)
+
+    def refill(self) -> None:
+        while len(self.keys) == 0 and self.readers:
+            if self.readers[0].exhausted():
+                self.readers.pop(0)
+                continue
+            self.keys, self.vals = self.readers[0].next_block()
+
+    def exhausted(self) -> bool:
+        self.refill()
+        return len(self.keys) == 0
+
+    def take(self, upto_key: Optional[np.void]) -> Tuple[np.ndarray, np.ndarray]:
+        """Pop the buffered prefix with keys <= upto_key (or all if None)."""
+        if upto_key is None:
+            k, v = self.keys, self.vals
+            self.keys = np.zeros(0, dtype=KEY_DTYPE)
+            self.vals = np.zeros(0, dtype=np.uint32)
+            return k, v
+        cut = int(np.searchsorted(self.keys, upto_key, side="right"))
+        k, v = self.keys[:cut], self.vals[:cut]
+        self.keys, self.vals = self.keys[cut:], self.vals[cut:]
+        return k, v
+
+    def last_buffered_key(self) -> np.void:
+        return self.keys[-1]
+
+
+class DurableIndex:
+    """u128 → u32 index over grid-backed sorted tables.
+
+    unique=True: keys inserted at most once (transfer id index); lookups
+    return the value or NOT_FOUND. unique=False: duplicate keys allowed
+    (secondary indexes, e.g. account → transfer row); `lookup_range` returns
+    every value for a key range in insertion order (values are monotone per
+    key because merges keep older runs first).
+    """
+
+    def __init__(
+        self,
+        grid: Grid,
+        *,
+        unique: bool = True,
+        memtable_max: int = 1 << 16,
+        growth: int = 8,
+        backend: str = "numpy",
+    ) -> None:
+        self.grid = grid
+        self.unique = unique
+        self.memtable_max = memtable_max
+        self.growth = growth
+        self.backend = backend
+        self._mem: List[Tuple[np.ndarray, np.ndarray]] = []
+        self._mem_count = 0
+        # levels[0] is newest-flush tables (append order = age order).
+        self.levels: List[List[TableInfo]] = [[]]
+        self.count = 0
+
+    # --- geometry -------------------------------------------------------
+
+    @property
+    def entries_per_block(self) -> int:
+        return (self.grid.payload_max - 16) // ENTRY_SIZE
+
+    @property
+    def fences_per_index(self) -> int:
+        return (self.grid.payload_max - 16) // INDEX_ENTRY_DTYPE.itemsize
+
+    # --- write path -----------------------------------------------------
+
+    def insert_batch(self, keys: np.ndarray, values: np.ndarray) -> None:
+        if len(keys) == 0:
+            return
+        self._mem.append((np.asarray(keys), np.asarray(values, dtype=np.uint32)))
+        self._mem_count += len(keys)
+        self.count += len(keys)
+        if self._mem_count >= self.memtable_max:
+            self.flush_memtable()
+
+    def flush_memtable(self) -> None:
+        if self._mem_count == 0:
+            return
+        keys = np.concatenate([k for k, _ in self._mem])
+        vals = np.concatenate([v for _, v in self._mem])
+        order = np.argsort(keys, kind="stable")
+        self._mem = []
+        self._mem_count = 0
+        table = self._build_table(keys[order], vals[order])
+        self.levels[0].append(table)
+        self._maybe_compact()
+
+    def _build_table(self, keys: np.ndarray, vals: np.ndarray) -> TableInfo:
+        """Write sorted entries as data blocks + one index block."""
+        epb = self.entries_per_block
+        n = len(keys)
+        assert n > 0
+        n_blocks = -(-n // epb)
+        assert n_blocks <= self.fences_per_index, "table exceeds one index block"
+        fences = np.zeros(n_blocks, dtype=INDEX_ENTRY_DTYPE)
+        for b in range(n_blocks):
+            part_k = keys[b * epb : (b + 1) * epb]
+            part_v = vals[b * epb : (b + 1) * epb]
+            payload = (
+                np.uint32(len(part_k)).tobytes()
+                + b"\x00" * 12
+                + part_k.tobytes()
+                + part_v.tobytes()
+            )
+            block = self.grid.write_block(payload, BLOCK_TYPE_DATA)
+            fences[b]["first_hi"], fences[b]["first_lo"] = part_k[0]["hi"], part_k[0]["lo"]
+            fences[b]["last_hi"], fences[b]["last_lo"] = part_k[-1]["hi"], part_k[-1]["lo"]
+            fences[b]["block"] = block
+            fences[b]["count"] = len(part_k)
+        index_payload = (
+            np.uint32(n_blocks).tobytes()
+            + np.uint32(0).tobytes()
+            + np.uint64(n).tobytes()
+            + fences.tobytes()
+        )
+        index_block = self.grid.write_block(index_payload, BLOCK_TYPE_INDEX)
+        return TableInfo(
+            index_block=index_block,
+            count=n,
+            key_min=(int(keys[0]["hi"]), int(keys[0]["lo"])),
+            key_max=(int(keys[-1]["hi"]), int(keys[-1]["lo"])),
+            _fences=fences,
+        )
+
+    def _table_fences(self, table: TableInfo) -> np.ndarray:
+        if table._fences is None:
+            payload = self.grid.read_block(table.index_block)
+            n_blocks = int(np.frombuffer(payload[:4], dtype="<u4")[0])
+            table._fences = np.frombuffer(
+                payload[16 : 16 + n_blocks * INDEX_ENTRY_DTYPE.itemsize],
+                dtype=INDEX_ENTRY_DTYPE,
+            )
+        return table._fences
+
+    def _read_data_block(self, block: int, count: int) -> Tuple[np.ndarray, np.ndarray]:
+        payload = self.grid.read_block(block)
+        n = int(np.frombuffer(payload[:4], dtype="<u4")[0])
+        assert n == count
+        koff = 16
+        voff = koff + n * KEY_DTYPE.itemsize
+        keys = np.frombuffer(payload[koff:voff], dtype=KEY_DTYPE)
+        vals = np.frombuffer(payload[voff : voff + n * 4], dtype=np.uint32)
+        return keys, vals
+
+    def _release_table(self, table: TableInfo) -> None:
+        for f in self._table_fences(table):
+            self.grid.release(int(f["block"]))
+        self.grid.release(table.index_block)
+
+    # --- compaction -----------------------------------------------------
+
+    def _maybe_compact(self) -> None:
+        level = 0
+        while level < len(self.levels) and len(self.levels[level]) > self.growth:
+            tables = self.levels[level]
+            # Fold pairwise, oldest first (stability: older run = A side).
+            # A fold step may emit several key-ordered non-overlapping
+            # tables when the output outgrows one index block.
+            merged = [tables[0]]
+            for t in tables[1:]:
+                new = self._merge_tables(merged, [t])
+                for old in merged:
+                    self._release_table(old)
+                self._release_table(t)
+                merged = new
+            self.levels[level] = []
+            if level + 1 >= len(self.levels):
+                self.levels.append([])
+            self.levels[level + 1].extend(merged)
+            level += 1
+
+    def _merge_chunk(self, ka, va, kb, vb) -> Tuple[np.ndarray, np.ndarray]:
+        from tigerbeetle_tpu.ops import merge as merge_ops
+
+        if self.backend == "jax":
+            lk, lv = merge_ops.merge_device(
+                _keys_to_limbs(ka), va, _keys_to_limbs(kb), vb
+            )
+            return _limbs_to_keys(lk), lv
+        return merge_ops.merge_host(ka, va, kb, vb)
+
+    def _merge_tables(
+        self, tables_a: List[TableInfo], tables_b: List[TableInfo]
+    ) -> List[TableInfo]:
+        """Streaming stable merge of two key-ordered table sequences,
+        O(block) memory; emits one or more non-overlapping tables."""
+        a = _MergeStream(self, tables_a)
+        b = _MergeStream(self, tables_b)
+        out = _TableWriter(self)
+        while True:
+            a_empty, b_empty = a.exhausted(), b.exhausted()
+            if a_empty and b_empty:
+                break
+            if b_empty:
+                out.append(*a.take(None))
+                continue
+            if a_empty:
+                out.append(*b.take(None))
+                continue
+            # Emit everything up to the smaller of the two buffered tails —
+            # all later input is strictly greater, so the prefix is final.
+            la, lb = a.last_buffered_key(), b.last_buffered_key()
+            # np.void scalars have no ordering ufunc — compare as tuples.
+            a_le = (int(la["hi"]), int(la["lo"])) <= (int(lb["hi"]), int(lb["lo"]))
+            bound = la if a_le else lb
+            ka, va = a.take(bound)
+            kb, vb = b.take(bound)
+            if len(ka) and len(kb):
+                mk, mv = self._merge_chunk(ka, va, kb, vb)
+                out.append(mk, mv)
+            elif len(ka):
+                out.append(ka, va)
+            elif len(kb):
+                out.append(kb, vb)
+        return out.finish()
+
+    # --- read path ------------------------------------------------------
+
+    def _tables_newest_first(self) -> List[TableInfo]:
+        out: List[TableInfo] = []
+        for level in self.levels:
+            out.extend(reversed(level))
+        return out
+
+    def lookup_batch(self, keys: np.ndarray) -> np.ndarray:
+        n = len(keys)
+        out = np.full(n, NOT_FOUND, dtype=np.uint32)
+        if n == 0:
+            return out
+        pending = np.ones(n, dtype=bool)
+        # Memtable first (newest writes win for unique indexes).
+        for mem_keys, mem_vals in reversed(self._mem):
+            order = np.argsort(mem_keys, kind="stable")
+            sk, sv = mem_keys[order], mem_vals[order]
+            ix = np.searchsorted(sk, keys)
+            ix_c = np.minimum(ix, len(sk) - 1)
+            hit = pending & (ix < len(sk)) & (sk[ix_c] == keys)
+            out[hit] = sv[ix_c[hit]]
+            pending &= ~hit
+        if not pending.any():
+            return out
+        for table in self._tables_newest_first():
+            if not pending.any():
+                break
+            self._lookup_table(table, keys, out, pending)
+        return out
+
+    def _lookup_table(self, table, keys, out, pending) -> None:
+        fences = self._table_fences(table)
+        # Candidate data block per key: first block whose last >= key.
+        last = np.zeros(len(fences), dtype=KEY_DTYPE)
+        last["hi"], last["lo"] = fences["last_hi"], fences["last_lo"]
+        cand = np.searchsorted(last, keys, side="left")
+        valid = pending & (cand < len(fences))
+        if not valid.any():
+            return
+        for b in np.unique(cand[valid]):
+            in_b = valid & (cand == b)
+            bk, bv = self._read_data_block(
+                int(fences[b]["block"]), int(fences[b]["count"])
+            )
+            ix = np.searchsorted(bk, keys[in_b])
+            ix_c = np.minimum(ix, len(bk) - 1)
+            hit = (ix < len(bk)) & (bk[ix_c] == keys[in_b])
+            rows = np.nonzero(in_b)[0][hit]
+            out[rows] = bv[ix_c[hit]]
+            pending[rows] = False
+
+    def contains_any(self, keys: np.ndarray) -> bool:
+        return bool(np.any(self.lookup_batch(keys) != NOT_FOUND))
+
+    def lookup_range(self, key: np.void) -> np.ndarray:
+        """All values stored under `key` (non-unique index), ascending."""
+        assert not self.unique
+        parts: List[np.ndarray] = []
+        for table in self._tables_newest_first():
+            fences = self._table_fences(table)
+            last = np.zeros(len(fences), dtype=KEY_DTYPE)
+            last["hi"], last["lo"] = fences["last_hi"], fences["last_lo"]
+            first = np.zeros(len(fences), dtype=KEY_DTYPE)
+            first["hi"], first["lo"] = fences["first_hi"], fences["first_lo"]
+            b_lo = int(np.searchsorted(last, key, side="left"))
+            b_hi = int(np.searchsorted(first, key, side="right"))
+            for b in range(b_lo, min(b_hi, len(fences))):
+                bk, bv = self._read_data_block(
+                    int(fences[b]["block"]), int(fences[b]["count"])
+                )
+                s = np.searchsorted(bk, key, side="left")
+                e = np.searchsorted(bk, key, side="right")
+                if e > s:
+                    parts.append(bv[s:e])
+        for mem_keys, mem_vals in self._mem:
+            hit = mem_keys == key
+            if hit.any():
+                parts.append(mem_vals[hit])
+        if not parts:
+            return np.zeros(0, dtype=np.uint32)
+        return np.sort(np.concatenate(parts), kind="stable")
+
+    # --- checkpoint -----------------------------------------------------
+
+    def checkpoint(self) -> np.ndarray:
+        """Flush the memtable and return the manifest (MANIFEST_DTYPE rows)."""
+        self.flush_memtable()
+        rows = []
+        for level, tables in enumerate(self.levels):
+            for t in tables:
+                rows.append(
+                    (level, t.index_block, t.count,
+                     t.key_min[0], t.key_min[1], t.key_max[0], t.key_max[1])
+                )
+        return np.array(rows, dtype=MANIFEST_DTYPE)
+
+    def restore(self, manifest: np.ndarray) -> None:
+        self._mem = []
+        self._mem_count = 0
+        self.levels = [[]]
+        self.count = 0
+        for rec in manifest:
+            level = int(rec["level"])
+            while level >= len(self.levels):
+                self.levels.append([])
+            t = TableInfo(
+                index_block=int(rec["index_block"]),
+                count=int(rec["count"]),
+                key_min=(int(rec["min_hi"]), int(rec["min_lo"])),
+                key_max=(int(rec["max_hi"]), int(rec["max_lo"])),
+            )
+            self.levels[level].append(t)
+            self.count += t.count
+
+
+class _TableWriter:
+    """Accumulates merged output, flushing full data blocks incrementally;
+    rolls over into a new table when the index block's fence capacity is
+    reached (output tables are key-ordered and non-overlapping)."""
+
+    def __init__(self, tree: DurableIndex) -> None:
+        self.tree = tree
+        self.parts_k: List[np.ndarray] = []
+        self.parts_v: List[np.ndarray] = []
+        self.buffered = 0
+        self.fences: List[tuple] = []
+        self.total = 0
+        self.done: List[TableInfo] = []
+
+    def append(self, keys: np.ndarray, vals: np.ndarray) -> None:
+        if len(keys) == 0:
+            return
+        self.parts_k.append(keys)
+        self.parts_v.append(vals)
+        self.buffered += len(keys)
+        epb = self.tree.entries_per_block
+        if self.buffered >= epb:
+            k = np.concatenate(self.parts_k)
+            v = np.concatenate(self.parts_v)
+            while len(k) >= epb:
+                self._flush_block(k[:epb], v[:epb])
+                k, v = k[epb:], v[epb:]
+            self.parts_k, self.parts_v = [k], [v]
+            self.buffered = len(k)
+
+    def _flush_block(self, keys: np.ndarray, vals: np.ndarray) -> None:
+        payload = (
+            np.uint32(len(keys)).tobytes() + b"\x00" * 12
+            + keys.tobytes() + np.ascontiguousarray(vals).tobytes()
+        )
+        block = self.tree.grid.write_block(payload, BLOCK_TYPE_DATA)
+        self.fences.append(
+            (int(keys[0]["hi"]), int(keys[0]["lo"]),
+             int(keys[-1]["hi"]), int(keys[-1]["lo"]),
+             block, len(keys))
+        )
+        self.total += len(keys)
+        if len(self.fences) >= self.tree.fences_per_index:
+            self._close_table()
+
+    def _close_table(self) -> None:
+        assert self.fences
+        fences = np.zeros(len(self.fences), dtype=INDEX_ENTRY_DTYPE)
+        for i, (fh, fl, lh, ll, b, c) in enumerate(self.fences):
+            fences[i] = (fh, fl, lh, ll, b, c)
+        index_payload = (
+            np.uint32(len(fences)).tobytes()
+            + np.uint32(0).tobytes()
+            + np.uint64(self.total).tobytes()
+            + fences.tobytes()
+        )
+        index_block = self.tree.grid.write_block(index_payload, BLOCK_TYPE_INDEX)
+        self.done.append(
+            TableInfo(
+                index_block=index_block,
+                count=self.total,
+                key_min=(int(fences[0]["first_hi"]), int(fences[0]["first_lo"])),
+                key_max=(int(fences[-1]["last_hi"]), int(fences[-1]["last_lo"])),
+                _fences=fences,
+            )
+        )
+        self.fences = []
+        self.total = 0
+
+    def finish(self) -> List[TableInfo]:
+        if self.buffered:
+            k = np.concatenate(self.parts_k)
+            v = np.concatenate(self.parts_v)
+            if len(k):
+                self._flush_block(k, v)
+        if self.fences:
+            self._close_table()
+        assert self.done, "empty merge output"
+        return self.done

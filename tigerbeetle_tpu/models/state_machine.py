@@ -31,7 +31,6 @@ from tigerbeetle_tpu.flags import AccountFlags, TransferFlags
 from tigerbeetle_tpu.lsm.store import (
     KEY_DTYPE,
     NOT_FOUND,
-    TransferLog,
     U128Index,
     pack_keys,
 )
@@ -121,9 +120,19 @@ class StateMachine:
     get_account_history.
     """
 
-    def __init__(self, config: Config = PRODUCTION, backend: str = "jax") -> None:
+    def __init__(
+        self, config: Config = PRODUCTION, backend: str = "jax", grid=None
+    ) -> None:
+        from tigerbeetle_tpu.io.grid import MemGrid
+
         self.config = config
         self.backend = backend
+        # The durable LSM tier (grid blocks + tables): replicas pass a grid
+        # over their data file's grid zone; standalone use gets a lazy
+        # in-memory grid with the same code path.
+        self.grid = grid if grid is not None else MemGrid(
+            config.grid_block_count, config.lsm_block_size
+        )
         a = config.accounts_max
 
         if backend == "jax":
@@ -153,9 +162,23 @@ class StateMachine:
         self.acc_timestamp = np.zeros(a, dtype=np.uint64)
         self.account_count = 0
 
+        from tigerbeetle_tpu.lsm.log import DurableLog
+        from tigerbeetle_tpu.lsm.tree import DurableIndex
+
+        # id → slot for accounts stays a RAM index (bounded by accounts_max);
+        # the transfer id index, account secondary index, and the object log
+        # live on the grid (reference groove.zig: id tree + indexes + object
+        # tree).
         self.account_index = U128Index()
-        self.transfer_index = U128Index()
-        self.transfer_log = TransferLog(types.TRANSFER_DTYPE)
+        self.transfer_index = DurableIndex(
+            self.grid, unique=True,
+            memtable_max=config.index_memtable_rows, backend=backend,
+        )
+        self.account_rows = DurableIndex(
+            self.grid, unique=False,
+            memtable_max=config.index_memtable_rows, backend=backend,
+        )
+        self.transfer_log = DurableLog(self.grid, types.TRANSFER_DTYPE)
         # pending-transfer timestamp → fulfillment (reference PostedGroove).
         self.posted: Dict[int, int] = {}
         self.history: List[oracle_mod.HistoryRow] = []
@@ -168,6 +191,20 @@ class StateMachine:
             "fast_batches": 0, "exact_batches": 0,
             "serial_batches": 0, "bail_batches": 0,
         }
+
+    def _store_new_transfers(self, recs: np.ndarray) -> None:
+        """Append committed transfers to the object log and both indexes
+        (reference groove insert: object tree + id tree + secondary
+        indexes, groove.zig:138)."""
+        rows = self.transfer_log.append_batch(recs)
+        self.transfer_index.insert_batch(
+            pack_keys(recs["id_lo"], recs["id_hi"]), rows
+        )
+        acct_keys = np.concatenate([
+            pack_keys(recs["debit_account_id_lo"], recs["debit_account_id_hi"]),
+            pack_keys(recs["credit_account_id_lo"], recs["credit_account_id_hi"]),
+        ])
+        self.account_rows.insert_batch(acct_keys, np.concatenate([rows, rows]))
 
     # ------------------------------------------------------------------
     # prepare (timestamp assignment, reference state_machine.zig:503-511)
@@ -421,8 +458,7 @@ class StateMachine:
         if np.any(ok):
             recs = events[ok].copy()
             recs["timestamp"] = ts[ok]
-            rows = self.transfer_log.append_batch(recs)
-            self.transfer_index.insert_batch(keys[ok], rows)
+            self._store_new_transfers(recs)
             self.commit_timestamp = int(ts[ok][-1])
         return _codes_to_results(codes)
 
@@ -486,8 +522,7 @@ class StateMachine:
             recs["timestamp"] = ts[ok]
             recs["amount_lo"] = amt_lo[ok]
             recs["amount_hi"] = amt_hi[ok]
-            rows = self.transfer_log.append_batch(recs)
-            self.transfer_index.insert_batch(keys[ok], rows)
+            self._store_new_transfers(recs)
             self.commit_timestamp = int(ts[ok][-1])
 
             # History rows from the kernel's post-event balances
@@ -550,8 +585,7 @@ class StateMachine:
         if np.any(ok):
             recs = events[ok].copy()
             recs["timestamp"] = ts[ok]
-            rows = self.transfer_log.append_batch(recs)
-            self.transfer_index.insert_batch(keys[ok], rows)
+            self._store_new_transfers(recs)
             self.commit_timestamp = int(ts[ok][-1])
         return _codes_to_results(codes)
 
@@ -700,10 +734,7 @@ class StateMachine:
                 np.atleast_1d(oracle_mod.transfer_to_numpy(dict.__getitem__(orc.transfers, i)))
                 for i in new_ts
             ])
-            rows = self.transfer_log.append_batch(recs)
-            self.transfer_index.insert_batch(
-                pack_keys(recs["id_lo"], recs["id_hi"]), rows
-            )
+            self._store_new_transfers(recs)
         self.commit_timestamp = orc.commit_timestamp
         return _results_array(pairs)
 
@@ -797,6 +828,18 @@ class StateMachine:
         found = rows != NOT_FOUND
         return self.transfer_log.gather(rows[found])
 
+    def _account_records(self, account_id: int) -> np.ndarray:
+        """All transfers touching the account, in commit (timestamp) order —
+        an account-index range read + gather, O(account's transfers), not
+        O(history) (reference ScanTree over the secondary index,
+        scan_tree.zig:31)."""
+        key = pack_keys(
+            np.array([account_id & U64_MAX], dtype=np.uint64),
+            np.array([account_id >> 64], dtype=np.uint64),
+        )[0]
+        rows = self.account_rows.lookup_range(key)
+        return self.transfer_log.gather(rows)
+
     def get_account_transfers(
         self,
         account_id: int,
@@ -809,7 +852,7 @@ class StateMachine:
 
         if not Oracle._filter_valid(account_id, timestamp_min, timestamp_max, limit, flags):
             return np.zeros(0, dtype=types.TRANSFER_DTYPE)
-        t = self.transfer_log.scan()
+        t = self._account_records(account_id)
         ts_min = np.uint64(timestamp_min if timestamp_min else 1)
         ts_max = np.uint64(timestamp_max if timestamp_max else U64_MAX - 1)
         lo = np.uint64(account_id & U64_MAX)
@@ -835,8 +878,9 @@ class StateMachine:
         limit: int = 8190,
         flags: int = 0x3,
     ) -> List[Tuple[int, int, int, int, int]]:
-        # History batches are always serial-path; delegate to oracle logic
-        # over the shared history list.
+        # History rows joined against the account's own transfer records
+        # (reference prefetch_get_account_history_scan): the secondary index
+        # bounds the join to this account's transfers.
         orc = self._make_oracle()
         self._preload_accounts(
             orc,
@@ -845,12 +889,11 @@ class StateMachine:
                 np.array([account_id >> 64], dtype=np.uint64),
             ),
         )
-        # The oracle scans transfers by timestamp; provide a view over the log.
-        t = self.transfer_log.scan()
-        by_ts = {}
-        for row in self.history:
-            ix = np.searchsorted(t["timestamp"], np.uint64(row.timestamp))
-            if ix < len(t) and t["timestamp"][ix] == row.timestamp:
-                by_ts[row.timestamp] = oracle_mod.transfer_from_numpy(t[ix])
-        orc.transfers.update({tr.id: tr for tr in by_ts.values()})
+        t = self._account_records(account_id)
+        orc.transfers.update(
+            {
+                tr.id: tr
+                for tr in (oracle_mod.transfer_from_numpy(t[i]) for i in range(len(t)))
+            }
+        )
         return orc.get_account_history(account_id, timestamp_min, timestamp_max, limit, flags)

@@ -13,9 +13,13 @@ the TPU data path does not cross this layer).
 from __future__ import annotations
 
 import asyncio
+import logging
+import traceback
 from typing import Dict, List, Optional, Tuple
 
 from tigerbeetle_tpu.vsr.header import HEADER_SIZE, Command, Header, Message
+
+log = logging.getLogger("tigerbeetle_tpu.bus")
 
 
 class _Conn:
@@ -69,11 +73,26 @@ class ReplicaServer:
 
     def send_to_replica(self, r: int, msg: Message) -> None:
         if r == self.me:
-            self.replica.on_message(msg.copy())
+            self._dispatch(msg.copy())
             return
         conn = self.peer_conns.get(r)
         if conn is not None:
             conn.send(msg.to_bytes())
+
+    def _dispatch(self, msg: Message) -> None:
+        """Fail-stop on replica exceptions (the reference's assert-and-crash
+        discipline): a half-applied commit must never keep serving — the WAL
+        makes a restart consistent, whereas a silently dead connection
+        handler leaves a wedged zombie."""
+        try:
+            self.replica.on_message(msg)
+        except Exception:
+            log.error(
+                "replica raised during on_message — failing stop:\n%s",
+                traceback.format_exc(),
+            )
+            self.stop()
+            raise
 
     def send_to_client(self, client_id: int, msg: Message) -> None:
         conn = self.client_conns.get(client_id)
@@ -155,7 +174,7 @@ class ReplicaServer:
             elif peer_replica is None and h["replica"] != self.me:
                 peer_replica = h["replica"]
                 self.peer_conns.setdefault(peer_replica, conn)
-            self.replica.on_message(msg)
+            self._dispatch(msg)
         if client_id is not None and self.client_conns.get(client_id) is conn:
             del self.client_conns[client_id]
         if peer_replica is not None and self.peer_conns.get(peer_replica) is conn:
@@ -167,4 +186,4 @@ class ReplicaServer:
             msg = await read_message(reader)
             if msg is None:
                 return
-            self.replica.on_message(msg)
+            self._dispatch(msg)

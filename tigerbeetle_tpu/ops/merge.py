@@ -1,0 +1,126 @@
+"""Device streaming-merge kernel for LSM compaction (north-star part 2).
+
+The reference's compaction inner loop is a serial k-way merge iterator
+(/root/reference/src/lsm/compaction.zig:743 + k_way_merge.zig:8): pop the
+smallest head among k sorted streams, append to the output block. The TPU
+re-expression is sort-free and fully data-parallel:
+
+    stable 2-way merge of sorted runs A (n) and B (m)
+      pos_A[i] = i + |{ j : B[j] <  A[i] }|
+      pos_B[j] = j + |{ i : A[i] <= B[j] }|
+    → two vectorized branchless binary searches (lax-unrolled, the device
+      analog of the reference's branchless binary_search.zig) + two
+      scatters. O((n+m)·log) lane-parallel work, no data-dependent control
+      flow, exact for multi-limb (u128) keys via lexicographic limb compares
+      (ops/u128.lt — no native u64/u128 on TPU).
+
+K-way level merges fold pairwise over this kernel, streaming block-sized
+windows through HBM (lsm/tree.py paces the windows). Stability contract:
+A's elements precede B's at equal keys — callers pass the OLDER run as A so
+duplicate-key secondary indexes keep insertion (row) order.
+
+Byte-equality vs the host merge (merge_host below) is enforced by
+tests/test_lsm.py property tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tigerbeetle_tpu.ops import u128
+
+I32 = jnp.int32
+
+
+def _bound(keys: jnp.ndarray, queries: jnp.ndarray, upper: bool) -> jnp.ndarray:
+    """Per-query count of `keys` elements < query (upper=False) or <= query
+    (upper=True). keys (n, W) sorted ascending; queries (m, W)."""
+    n = keys.shape[0]
+    m = queries.shape[0]
+    lo = jnp.zeros((m,), dtype=I32)
+    hi = jnp.full((m,), n, dtype=I32)
+    if n == 0:
+        return lo
+    steps = int(n).bit_length() + 1
+    for _ in range(steps):
+        mid = (lo + hi) >> 1
+        kmid = keys[jnp.clip(mid, 0, n - 1)]
+        pred = u128.le(kmid, queries) if upper else u128.lt(kmid, queries)
+        active = lo < hi
+        lo = jnp.where(active & pred, mid + 1, lo)
+        hi = jnp.where(active & ~pred, mid, hi)
+    return lo
+
+
+@functools.partial(jax.jit, static_argnames=())
+def merge_kernel(keys_a, vals_a, keys_b, vals_b):
+    """Stable merge of two padded sorted runs (pads = all-ones sentinel keys,
+    which sort past every legal key). Returns (keys (n+m, W), vals (n+m,))."""
+    n = keys_a.shape[0]
+    m = keys_b.shape[0]
+    pos_a = jnp.arange(n, dtype=I32) + _bound(keys_b, keys_a, upper=False)
+    pos_b = jnp.arange(m, dtype=I32) + _bound(keys_a, keys_b, upper=True)
+    out_keys = jnp.zeros((n + m, keys_a.shape[1]), dtype=keys_a.dtype)
+    out_keys = out_keys.at[pos_a].set(keys_a).at[pos_b].set(keys_b)
+    out_vals = jnp.zeros((n + m,), dtype=vals_a.dtype)
+    out_vals = out_vals.at[pos_a].set(vals_a).at[pos_b].set(vals_b)
+    return out_keys, out_vals
+
+
+_SENTINEL = 0xFFFFFFFF
+
+
+def _pad_pow2(keys: np.ndarray, vals: np.ndarray):
+    """Pad to the next power-of-two bucket with all-ones sentinel keys so the
+    kernel compiles once per bucket size, not per run length."""
+    n = len(keys)
+    n_pad = 1 << max(4, (max(n, 1) - 1).bit_length())
+    if n == n_pad:
+        return keys, vals
+    pk = np.full((n_pad, keys.shape[1]), _SENTINEL, dtype=keys.dtype)
+    pk[:n] = keys
+    pv = np.zeros((n_pad,), dtype=vals.dtype)
+    pv[:n] = vals
+    return pk, pv
+
+
+def merge_device(keys_a, vals_a, keys_b, vals_b):
+    """Host wrapper: pad → device merge → slice. Keys are (n, W) u32 limb
+    arrays; all real keys must be < the all-ones sentinel (ids and
+    timestamps are validated != INT_MAX upstream)."""
+    n, m = len(keys_a), len(keys_b)
+    ka, va = _pad_pow2(np.asarray(keys_a), np.asarray(vals_a))
+    kb, vb = _pad_pow2(np.asarray(keys_b), np.asarray(vals_b))
+    ok, ov = merge_kernel(ka, va, kb, vb)
+    return np.asarray(ok)[: n + m], np.asarray(ov)[: n + m]
+
+
+def merge_host(keys_a, vals_a, keys_b, vals_b):
+    """Numpy reference with identical semantics (byte-equality oracle and
+    the CPU-backend fallback). Keys as structured (hi, lo) or limb arrays —
+    anything np.searchsorted can order; limb arrays are compared via a
+    packed structured view."""
+    ka, kb = np.asarray(keys_a), np.asarray(keys_b)
+    if ka.dtype.fields is None:
+        # (n, W) u32 limbs → structured (w3, w2, w1, w0) for lexicographic
+        # compare, most significant limb first.
+        w = ka.shape[1]
+        dt = np.dtype([(f"w{i}", "<u4") for i in range(w)])
+        pa = np.ascontiguousarray(ka[:, ::-1]).view(dt).reshape(-1)
+        pb = np.ascontiguousarray(kb[:, ::-1]).view(dt).reshape(-1)
+    else:
+        pa, pb = ka, kb
+    n, m = len(pa), len(pb)
+    pos_a = np.arange(n) + np.searchsorted(pb, pa, side="left")
+    pos_b = np.arange(m) + np.searchsorted(pa, pb, side="right")
+    out_keys = np.zeros((n + m, *ka.shape[1:]), dtype=ka.dtype)
+    out_vals = np.zeros((n + m,), dtype=np.asarray(vals_a).dtype)
+    out_keys[pos_a] = ka
+    out_keys[pos_b] = kb
+    out_vals[pos_a] = vals_a
+    out_vals[pos_b] = vals_b
+    return out_keys, out_vals

@@ -203,7 +203,9 @@ class Cluster:
         self.config = config
         self.net = PacketSimulator(seed, loss_probability=loss)
         self.zone = Zone.for_config(
-            config.journal_slot_count, config.message_size_max, config.clients_max
+            config.journal_slot_count, config.message_size_max, config.clients_max,
+            grid_block_count=config.grid_block_count,
+            grid_block_size=config.lsm_block_size,
         )
         self.storages = [
             MemStorage(self.zone.total_size, seed=seed * 97 + i)

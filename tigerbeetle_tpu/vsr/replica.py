@@ -117,7 +117,20 @@ class Replica:
         self.journal = Journal(
             storage, zone, config.journal_slot_count, config.message_size_max
         )
-        self.state_machine = StateMachine(config, backend=sm_backend)
+        # Durable LSM tier over the data file's grid zone (deferred frees:
+        # blocks of the last durable checkpoint are never reused before the
+        # next checkpoint commits). Zones without a grid (journal-only unit
+        # fixtures) fall back to the state machine's in-memory grid.
+        if zone.grid_size:
+            from tigerbeetle_tpu.io.grid import Grid
+
+            self.grid = Grid(
+                storage, zone.grid_offset, zone.grid_block_count,
+                zone.grid_block_size, defer_releases=True,
+            )
+        else:
+            self.grid = None
+        self.state_machine = StateMachine(config, backend=sm_backend, grid=self.grid)
 
         self.status = STATUS_RECOVERING
         self.view = 0
@@ -816,7 +829,10 @@ class Replica:
         blob = self.snapshot_store.load(st.op_checkpoint)
         if blob is None:
             return None
-        self._sync_serve_cache = (st.op_checkpoint, blob, hdr.checksum(blob))
+        # Local blobs reference OUR grid blocks; peers need the transfers
+        # materialized (grid-block sync is a later round).
+        export = snapshot.to_export(self, blob)
+        self._sync_serve_cache = (st.op_checkpoint, export, hdr.checksum(export))
         return self._sync_serve_cache
 
     def _send_sync_chunk(self, peer: int, index: int) -> None:
@@ -916,20 +932,42 @@ class Replica:
         self._install_sync_checkpoint(sync_op, blob)
 
     def _install_sync_checkpoint(self, sync_op: int, blob: bytes) -> None:
-        """Install a peer's checkpoint: reset the state machine to the
-        snapshot and resume WAL repair from there."""
-        old_sm, old_clients = self.state_machine, self.clients
-        self.state_machine = StateMachine(self.config, backend=self.sm_backend)
+        """Install a peer's checkpoint, then advance our own durable
+        checkpoint to it and resume WAL repair from there.
+
+        Crash-consistency: the install writes ONLY into currently-free grid
+        blocks — blocks referenced by the last durable checkpoint (and by
+        the live state, for rollback) are untouched, so a crash at any
+        point before the new superblock is durable recovers cleanly to the
+        old checkpoint. Stale blocks are reclaimed only after the new
+        checkpoint lands, by rewinding the free set to the freshly encoded
+        local blob.
+        """
+        # Parse-validate BEFORE any destructive step: a checksum-consistent
+        # but structurally malformed blob (corrupt store entry or forged
+        # ident) must neither crash the replica loop nor destroy state.
+        if not snapshot.validate_export(blob):
+            return
+        from tigerbeetle_tpu.io.grid import FreeSet
+
+        grid = self.state_machine.grid
+        old_sm, old_clients, old_free = self.state_machine, self.clients, grid.free_set
+        install_free = FreeSet(grid.block_count)
+        install_free.free = old_free.free.copy()  # staged frees stay allocated
+        grid.free_set = install_free
+        self.state_machine = StateMachine(
+            self.config, backend=self.sm_backend, grid=grid
+        )
         # The client table is replicated state — it must exactly match the
         # installed checkpoint, so sessions from before the sync are dropped.
         self.clients = {}
         try:
             self._load_snapshot(blob)
         except Exception:
-            # Checksum-consistent but structurally malformed blob (corrupt
-            # store entry or forged ident): decoding must never crash the
-            # replica loop or leave half-installed state — restore and let a
-            # later sync attempt start fresh.
+            # Residual failure (e.g. grid transiently full): every block the
+            # old state references is intact — roll back wholesale.
+            grid.free_set = old_free
+            grid.drop_cache()
             self.state_machine, self.clients = old_sm, old_clients
             return
         self.commit_min = sync_op
@@ -939,11 +977,23 @@ class Replica:
         st.op_checkpoint = sync_op
         st.commit_min = sync_op
         st.commit_max = max(st.commit_max, sync_op)
+        # Persist OUR OWN local-mode checkpoint of the installed state (the
+        # export blob references no grid blocks and would force a full LSM
+        # rebuild on restart), make its blocks durable, then advance the
+        # superblock.
+        local_blob = self._save_snapshot()
         if self.snapshot_store is not None:
-            self.snapshot_store.save(sync_op, blob)
+            self.snapshot_store.save(sync_op, local_blob)
+        self.storage.sync()
         self.superblock.checkpoint()
+        # New checkpoint durable: reclaim everything it does not reference
+        # (the old checkpoint's and pre-sync live blocks).
+        fs_bytes = snapshot.free_set_bytes(local_blob)
+        if fs_bytes is not None:
+            grid.free_set.restore(fs_bytes)
         if self.snapshot_store is not None:
             self.snapshot_store.prune(keep_op=sync_op)
+        self._sync_serve_cache = None
         self.on_event("sync", self)
         self._commit_journal(self.commit_max)
 
@@ -1340,7 +1390,10 @@ class Replica:
         if self.commit_min <= self.superblock.state.op_checkpoint:
             return
         if self.snapshot_store is not None:
+            # encode() flushes LSM memtables into grid blocks; those blocks
+            # must be durable before the superblock may reference them.
             self.snapshot_store.save(self.commit_min, self._save_snapshot())
+            self.storage.sync()
         st = self.superblock.state
         st.op_checkpoint = self.commit_min
         st.commit_min = self.commit_min
@@ -1350,8 +1403,11 @@ class Replica:
         st.prepare_timestamp = self.state_machine.prepare_timestamp
         st.commit_timestamp = self.state_machine.commit_timestamp
         self.superblock.checkpoint()
+        # The checkpoint is durable: staged grid frees (tables replaced by
+        # compaction since the last checkpoint) may now be reused, and
+        # older snapshots may go.
+        self.state_machine.grid.commit_releases()
         if self.snapshot_store is not None:
-            # Only after the superblock is durable may older snapshots go.
             self.snapshot_store.prune(keep_op=self.commit_min)
         self.on_event("checkpoint", self)
 

@@ -98,8 +98,17 @@ def history_from_array(arr: np.ndarray) -> List:
     return out
 
 
-def encode(replica) -> bytes:
-    """Serialize the replica's replicated state at its current commit point."""
+def encode(replica, mode: str = "local") -> bytes:
+    """Serialize the replica's replicated state at its current commit point.
+
+    mode="local": the checkpoint blob for THIS replica's own recovery —
+    transfers stay in the grid; the blob carries only the LSM manifests,
+    the log's block list + tail, and the EWAH free set (small, O(tables)).
+    mode="export": a self-contained blob for state sync to a peer whose
+    grid differs — transfers are materialized in full (grid-block sync is
+    a later round; reference request_blocks/on_block, replica.zig:2289).
+    """
+    assert mode in ("local", "export")
     sm = replica.state_machine
     count = sm.account_count
     dp, dpo, cp, cpo = sm._read_balances(np.arange(count, dtype=np.int64))
@@ -114,10 +123,8 @@ def encode(replica) -> bytes:
         client_rows[i]["reply_len"] = len(raw)
         reply_blobs.append(raw)
 
-    buf = _io.BytesIO()
-    np.savez(
-        buf,
-        version=np.uint32(2),
+    sections = dict(
+        version=np.uint32(3),
         account_count=np.int64(count),
         acc_key_hi=sm.acc_key["hi"][:count], acc_key_lo=sm.acc_key["lo"][:count],
         acc_ud128_lo=sm.acc_user_data_128_lo[:count],
@@ -126,7 +133,6 @@ def encode(replica) -> bytes:
         acc_ledger=sm.acc_ledger[:count], acc_code=sm.acc_code[:count],
         acc_flags=sm.acc_flags[:count], acc_ts=sm.acc_timestamp[:count],
         bal_dp=dp, bal_dpo=dpo, bal_cp=cp, bal_cpo=cpo,
-        transfers=sm.transfer_log.scan(),
         posted_keys=np.array(sorted(sm.posted.keys()), dtype=np.uint64),
         posted_vals=np.array(
             [sm.posted[k] for k in sorted(sm.posted.keys())], dtype=np.uint8
@@ -137,7 +143,103 @@ def encode(replica) -> bytes:
         client_table=client_rows,
         client_replies=np.frombuffer(b"".join(reply_blobs), dtype=np.uint8),
     )
+    if mode == "export":
+        sections["transfers"] = sm.transfer_log.export_all()
+    else:
+        log_blocks, log_tail = sm.transfer_log.checkpoint()
+        sections["ti_manifest"] = sm.transfer_index.checkpoint()
+        sections["ai_manifest"] = sm.account_rows.checkpoint()
+        sections["log_blocks"] = log_blocks
+        sections["log_tail"] = log_tail
+        sections["free_set"] = np.frombuffer(
+            sm.grid.free_set.encode(), dtype=np.uint8
+        )
+
+    buf = _io.BytesIO()
+    np.savez(buf, **sections)
     return buf.getvalue()
+
+
+def to_export(replica, local_blob: bytes) -> bytes:
+    """Serve side of state sync: turn a local checkpoint blob into a
+    self-contained export blob by materializing the transfer log the local
+    manifest references (the serving replica's own grid blocks — immutable
+    until the next checkpoint commits, by the staged-release discipline)."""
+    z = np.load(_io.BytesIO(local_blob), allow_pickle=False)
+    if "transfers" in z:
+        return local_blob  # already export-shaped
+    from tigerbeetle_tpu import types
+    from tigerbeetle_tpu.lsm.log import DurableLog
+
+    log = DurableLog(replica.state_machine.grid, types.TRANSFER_DTYPE)
+    log.restore(z["log_blocks"], z["log_tail"])
+    skip = {"ti_manifest", "ai_manifest", "log_blocks", "log_tail", "free_set"}
+    sections = {k: z[k] for k in z.files if k not in skip}
+    sections["transfers"] = log.export_all()
+    buf = _io.BytesIO()
+    np.savez(buf, **sections)
+    return buf.getvalue()
+
+
+_EXPORT_REQUIRED = (
+    "account_count", "acc_key_hi", "acc_key_lo",
+    "acc_ud128_lo", "acc_ud128_hi", "acc_ud64", "acc_ud32",
+    "acc_ledger", "acc_code", "acc_flags", "acc_ts",
+    "bal_dp", "bal_dpo", "bal_cp", "bal_cpo",
+    "transfers", "posted_keys", "posted_vals",
+    "history", "prepare_timestamp", "commit_timestamp", "client_table",
+    "client_replies",
+)
+
+
+def validate_export(blob: bytes) -> bool:
+    """Parse-check an export blob BEFORE destructive install: np.load with
+    pickle disabled, every section install() reads present, and shapes
+    coherent. Defense in depth — install() is additionally wrapped in a
+    rollback — but a blob passing here should not make install() raise."""
+    from tigerbeetle_tpu import types
+
+    try:
+        z = np.load(_io.BytesIO(blob), allow_pickle=False)
+        for k in _EXPORT_REQUIRED:
+            _ = z[k]
+        count = int(z["account_count"])
+        if count < 0:
+            return False
+        for k in _EXPORT_REQUIRED[1:11]:
+            if z[k].shape != (count,):
+                return False
+        for k in ("bal_dp", "bal_dpo", "bal_cp", "bal_cpo"):
+            if z[k].shape != (count, 4):
+                return False
+        t = z["transfers"]
+        if t.dtype != types.TRANSFER_DTYPE and (
+            t.dtype.itemsize != types.TRANSFER_DTYPE.itemsize or t.ndim != 1
+        ):
+            return False
+        if z["posted_keys"].shape != z["posted_vals"].shape:
+            return False
+        if z["history"].dtype != HISTORY_DTYPE:
+            return False
+        if z["client_table"].dtype != CLIENT_ENTRY_DTYPE:
+            return False
+        if int(z["client_table"]["reply_len"].sum()) != len(z["client_replies"]):
+            return False
+        return True
+    except Exception:
+        return False
+
+
+def free_set_bytes(blob: bytes) -> bytes | None:
+    """The EWAH free-set section of a local checkpoint blob (None for
+    export-shaped blobs)."""
+    try:
+        z = np.load(_io.BytesIO(blob), allow_pickle=False)
+        if "free_set" not in z:
+            return None
+        return z["free_set"].tobytes()
+    except Exception:
+        return None
 
 
 def install(replica, blob: bytes) -> None:
@@ -174,14 +276,21 @@ def install(replica, blob: bytes) -> None:
         np.arange(count, dtype=np.int32),
         z["bal_dp"], z["bal_dpo"], z["bal_cp"], z["bal_cpo"],
     )
-    transfers = z["transfers"]
-    if len(transfers):
-        if transfers.dtype != types.TRANSFER_DTYPE:
-            transfers = transfers.view(types.TRANSFER_DTYPE)
-        rows = sm.transfer_log.append_batch(transfers)
-        sm.transfer_index.insert_batch(
-            pack_keys(transfers["id_lo"], transfers["id_hi"]), rows
-        )
+    if "transfers" in z:
+        # Export blob (state sync): rebuild the LSM tier in our own grid.
+        transfers = z["transfers"]
+        if len(transfers):
+            if transfers.dtype != types.TRANSFER_DTYPE:
+                transfers = transfers.view(types.TRANSFER_DTYPE)
+            sm._store_new_transfers(transfers)
+    else:
+        # Local checkpoint blob: state lives in our grid — rewind the free
+        # set to the checkpoint and re-attach manifests / log blocks.
+        sm.grid.free_set.restore(z["free_set"].tobytes())
+        sm.grid.drop_cache()
+        sm.transfer_index.restore(z["ti_manifest"])
+        sm.account_rows.restore(z["ai_manifest"])
+        sm.transfer_log.restore(z["log_blocks"], z["log_tail"])
     sm.posted = {
         int(k): int(v) for k, v in zip(z["posted_keys"], z["posted_vals"])
     }
