@@ -41,16 +41,19 @@ from tigerbeetle_tpu.results import CreateTransferResult as TR
 
 U64_MAX = types.U64_MAX
 
-# Flags that still force the serial oracle path: linked chains and
-# post/void-pending (in-batch pending resolution is the next kernel stage).
-_SERIAL_TRANSFER_FLAGS = np.uint16(
-    TransferFlags.LINKED
+# Flags handled by the exact (fixed-point sweep) kernel, not the simple one.
+# Since round 3 this covers linked chains and pending post/void too — no
+# flag forces the serial path anymore; only duplicate/existing ids and
+# post/void of a same-batch pending do (see create_transfers routing).
+_EXACT_TRANSFER_FLAGS = np.uint16(
+    TransferFlags.BALANCING_DEBIT
+    | TransferFlags.BALANCING_CREDIT
+    | TransferFlags.LINKED
     | TransferFlags.POST_PENDING_TRANSFER
     | TransferFlags.VOID_PENDING_TRANSFER
 )
-# Flags handled by the exact (fixed-point sweep) kernel, not the simple one.
-_EXACT_TRANSFER_FLAGS = np.uint16(
-    TransferFlags.BALANCING_DEBIT | TransferFlags.BALANCING_CREDIT
+_PV_FLAGS = np.uint16(
+    TransferFlags.POST_PENDING_TRANSFER | TransferFlags.VOID_PENDING_TRANSFER
 )
 _EXACT_ACCOUNT_FLAGS = np.uint32(
     AccountFlags.DEBITS_MUST_NOT_EXCEED_CREDITS

@@ -1,37 +1,51 @@
 """Order-dependent create_transfers semantics on device: balancing clamps,
-limit flags, history balances — via speculative fixed-point sweeps.
+limit flags, history balances, linked chains, and pending post/void — via
+speculative fixed-point sweeps.
 
-The reference executes these serially because each event's outcome depends on
-the balances produced by its predecessors (/root/reference/src/
-state_machine.zig:1286-1306 balancing clamps, :1323-1324 net-debit/credit cap,
-tigerbeetle.zig:31-39 limit predicates). The TPU re-expression (SURVEY.md §7
-hard part (b)) decomposes that serial dependency into data-parallel sweeps:
+The reference executes these serially because each event's outcome depends
+on the state produced by its predecessors (/root/reference/src/
+state_machine.zig:1286-1306 balancing clamps, :1002-1088 linked-chain
+scopes, :1391-1498 post/void, tigerbeetle.zig:31-39 limit predicates). The
+TPU re-expression (SURVEY.md §7 hard part (b)) decomposes the serial
+dependency into data-parallel sweeps:
 
   1. Sort the 2n (account, event) postings once by (slot, event index).
+     Chains are contiguous in event order, so (slot, chain) sub-segments
+     are contiguous inside each slot segment — one sort serves both.
   2. Speculate outcomes (initially: every statically-valid event succeeds
-     with its unclamped amount).
+     with its unclamped/resolved amount).
   3. Sweep: segmented exclusive prefix sums over u16 half-limb lanes give
      every event the exact u128 balances its account pair would hold if the
-     current speculation were true; re-run the dynamic validation ladder
-     (clamps, overflows, limit checks) against those balances.
-  4. Iterate until a fixed point. The system is triangular — event i's
-     outcome depends only on events j < i — so the fixed point is unique and
-     equals the serial execution exactly; each sweep finalizes at least one
-     more level of the dependency chain, and workloads where outcomes don't
-     flip (the common case) converge in two sweeps. A batch that has not
-     stabilized after `max_sweeps` raises `bail` and the host falls back to
-     the serial oracle.
+     current speculation were true. Linked-chain scope visibility is
+     observer-dependent — an event sees same-chain predecessors' effects
+     even while the chain's fate is open, but other chains' effects only if
+     the whole chain succeeds — so each balance field takes TWO prefixes:
+       A: effect = ok & chain_ok, segmented by slot (cross-chain view);
+       B: effect = ok & ~chain_ok, segmented by (slot, chain) (the
+          correction visible only from inside the same chain).
+     Post/void adds pending-removal lanes (debits/credits_pending -= the
+     pending's amount on the PENDING's account pair) and an in-batch
+     fulfillment prefix-OR per referenced pending (first successful
+     post/void wins; later ones see ALREADY_POSTED/VOIDED).
+  4. Re-run the dynamic validation ladder against those balances; fold
+     chain outcomes (segment-AND of ok over each chain); iterate to a
+     fixed point. The dependency order is triangular at the chain level, so
+     the fixed point is unique and equals the serial execution exactly. A
+     batch that has not stabilized after `max_sweeps` raises `bail` and the
+     host falls back to the serial oracle.
 
-Exactness: all balance arithmetic is u128 (or wider) via uint32 limbs; prefix
-sums run in u16 half-limb lanes (≤ 2^14 terms of < 2^16 each — no wrap), so
-observed balances at the fixed point are bit-exact. The ladder below mirrors
-the reference's rung order rung-for-rung; results.py codes are
-precedence-ordered so host/device rungs merge via nonzero-minimum.
+Exactness: all balance arithmetic is u128 (or wider) via uint32 limbs;
+prefix sums run in u16 half-limb lanes (≤ 2^16 terms of < 2^16 each — no
+wrap), subtractions saturate during speculation and are borrow-free at the
+fixed point. The ladder mirrors the reference's rung order rung-for-rung;
+results.py codes are precedence-ordered so host/device rungs merge via
+nonzero-minimum (the pv ladder's host rungs 25-30 sit strictly between the
+device rungs 7..17 and 31..35).
 
-Stage limits (host dispatcher enforces): linked chains, post/void-pending,
-and duplicate/existing transfer ids still route to the serial path; this
-kernel covers balancing/limit/history batches (BASELINE config 4) plus
-everything the simple kernel handles.
+Stage limits (host dispatcher enforces): duplicate/existing transfer ids
+and post/void of a pending CREATED IN THE SAME BATCH still route to the
+serial path; everything else — BASELINE configs 3 and 4 included — runs
+here.
 """
 
 from __future__ import annotations
@@ -47,8 +61,6 @@ from tigerbeetle_tpu.ops.commit import (
     AF_DEBITS_MUST_NOT_EXCEED_CREDITS,
     F_BAL_CR,
     F_BAL_DR,
-    F_LINKED,
-    F_PADDING,
     F_PENDING,
     F_POST,
     F_VOID,
@@ -56,17 +68,36 @@ from tigerbeetle_tpu.ops.commit import (
     LedgerState,
     TransferBatch,
     _ladder,
-    apply_posting_streamed,
     merge_codes,
 )
 from tigerbeetle_tpu.results import CreateTransferResult as TR
 
 U32 = jnp.uint32
+I32 = jnp.int32
 MAX_SWEEPS = 64
 
 _U64_MAX_LIMBS = (0xFFFFFFFF, 0xFFFFFFFF, 0, 0)
 
 BAL_FIELDS = ("debits_pending", "debits_posted", "credits_pending", "credits_posted")
+
+FULFILL_NONE = -1
+FULFILL_POSTED = 0
+FULFILL_VOIDED = 1
+
+
+class PendingInfo(NamedTuple):
+    """Host-prefetched pending-transfer context for post/void events
+    (the reference's prefetch of p = transfers[t.pending_id],
+    state_machine.zig:560-655). Rows for non-post/void events are inert."""
+
+    found: jnp.ndarray  # (n,) bool — pending_id resolved in the store
+    amount: jnp.ndarray  # (n, 4) u32 — p.amount
+    dr_slot: jnp.ndarray  # (n,) i32 — p.debit_account_id's slot
+    cr_slot: jnp.ndarray  # (n,) i32
+    timestamp: jnp.ndarray  # (n, 2) u32 — p.timestamp (u64)
+    timeout: jnp.ndarray  # (n,) u32 — p.timeout (seconds)
+    base_fulfillment: jnp.ndarray  # (n,) i32 — pre-batch posted-groove state
+    group: jnp.ndarray  # (n,) i32 — same referenced pending ⇒ same group; n for non-pv
 
 
 class Observed(NamedTuple):
@@ -78,36 +109,96 @@ class Observed(NamedTuple):
     credits_posted: jnp.ndarray
 
 
-def _static_ladder(state: LedgerState, b: TransferBatch):
-    """Order-independent rungs (reference ladder up to the exists check),
-    with the balancing amendment: zero amount is legal when a balancing flag
-    is set (the clamp sentinel applies instead, state_machine.zig:1291)."""
+def _static_ladder(state: LedgerState, b: TransferBatch, is_pv):
+    """Order-independent rungs for REGULAR (non-post/void) events
+    (reference ladder up to the exists check), with the balancing
+    amendment: zero amount is legal when a balancing flag is set (the clamp
+    sentinel applies instead, state_machine.zig:1291). The shared prefix
+    (reserved flag, id zero/max) is evaluated for every event; the rest is
+    masked to regular events — post/void branches to its own ladder."""
     n = b.flags.shape[0]
     flags = b.flags
     pend = (flags & F_PENDING) != 0
     balancing = (flags & (F_BAL_DR | F_BAL_CR)) != 0
 
-    code = jnp.zeros((n,), dtype=U32)
-    code = _ladder(code, (flags & F_PADDING) != 0, TR.RESERVED_FLAG)
-    code = _ladder(code, u128.is_zero(b.id), TR.ID_MUST_NOT_BE_ZERO)
-    code = _ladder(code, u128.is_max(b.id), TR.ID_MUST_NOT_BE_INT_MAX)
-    code = _ladder(code, ~u128.is_zero(b.pending_id), TR.PENDING_ID_MUST_BE_ZERO)
-    code = _ladder(code, ~pend & (b.timeout != 0), TR.TIMEOUT_RESERVED_FOR_PENDING_TRANSFER)
-    code = _ladder(code, ~balancing & u128.is_zero(b.amount), TR.AMOUNT_MUST_NOT_BE_ZERO)
-    code = _ladder(code, b.ledger == 0, TR.LEDGER_MUST_NOT_BE_ZERO)
-    code = _ladder(code, b.code == 0, TR.CODE_MUST_NOT_BE_ZERO)
+    code = _shared_prefix(b)
+    reg = ~is_pv
 
-    code = _ladder(code, b.dr_slot < 0, TR.DEBIT_ACCOUNT_NOT_FOUND)
-    code = _ladder(code, b.cr_slot < 0, TR.CREDIT_ACCOUNT_NOT_FOUND)
+    code = _ladder(code, reg & ~u128.is_zero(b.pending_id), TR.PENDING_ID_MUST_BE_ZERO)
+    code = _ladder(
+        code, reg & ~pend & (b.timeout != 0), TR.TIMEOUT_RESERVED_FOR_PENDING_TRANSFER
+    )
+    code = _ladder(code, reg & ~balancing & u128.is_zero(b.amount), TR.AMOUNT_MUST_NOT_BE_ZERO)
+    code = _ladder(code, reg & (b.ledger == 0), TR.LEDGER_MUST_NOT_BE_ZERO)
+    code = _ladder(code, reg & (b.code == 0), TR.CODE_MUST_NOT_BE_ZERO)
+
+    code = _ladder(code, reg & (b.dr_slot < 0), TR.DEBIT_ACCOUNT_NOT_FOUND)
+    code = _ladder(code, reg & (b.cr_slot < 0), TR.CREDIT_ACCOUNT_NOT_FOUND)
 
     a_max = state.ledger.shape[0] - 1
     dr_ledger = state.ledger[jnp.clip(b.dr_slot, 0, a_max)]
     cr_ledger = state.ledger[jnp.clip(b.cr_slot, 0, a_max)]
-    code = _ladder(code, dr_ledger != cr_ledger, TR.ACCOUNTS_MUST_HAVE_THE_SAME_LEDGER)
+    code = _ladder(code, reg & (dr_ledger != cr_ledger), TR.ACCOUNTS_MUST_HAVE_THE_SAME_LEDGER)
     code = _ladder(
-        code, b.ledger != dr_ledger, TR.TRANSFER_MUST_HAVE_THE_SAME_LEDGER_AS_ACCOUNTS
+        code, reg & (b.ledger != dr_ledger),
+        TR.TRANSFER_MUST_HAVE_THE_SAME_LEDGER_AS_ACCOUNTS,
     )
     return code
+
+
+def _shared_prefix(b: TransferBatch):
+    """Rungs common to both ladders (state_machine.zig:1243-1253)."""
+    n = b.flags.shape[0]
+    # RESERVED_FLAG uses the raw padding mask but post/void bits are legal;
+    # F_PADDING excludes all defined bits already (commit.py).
+    from tigerbeetle_tpu.ops.commit import F_PADDING
+
+    code = jnp.zeros((n,), dtype=U32)
+    code = _ladder(code, (b.flags & F_PADDING) != 0, TR.RESERVED_FLAG)
+    code = _ladder(code, u128.is_zero(b.id), TR.ID_MUST_NOT_BE_ZERO)
+    code = _ladder(code, u128.is_max(b.id), TR.ID_MUST_NOT_BE_INT_MAX)
+    return code
+
+
+def _pv_static_ladder(b: TransferBatch, p: PendingInfo, is_pv, resolved, ts_expired):
+    """Order-independent rungs of the post/void ladder
+    (state_machine.zig:1391-1460; oracle._post_or_void_pending_transfer).
+    The store-dependent rungs (p found / not pending / field mismatches,
+    codes 25-30) come from the host via host_code; their values sit between
+    this function's early rungs (≤17) and late rungs (≥31), so the
+    nonzero-minimum merge lands every rung at its exact precedence."""
+    flags = b.flags
+    post = (flags & F_POST) != 0
+    void = (flags & F_VOID) != 0
+    bal = (flags & (F_BAL_DR | F_BAL_CR)) != 0
+    pend = (flags & F_PENDING) != 0
+
+    code = _shared_prefix(b)
+    code = _ladder(code, is_pv & post & void, TR.FLAGS_ARE_MUTUALLY_EXCLUSIVE)
+    code = _ladder(code, is_pv & pend, TR.FLAGS_ARE_MUTUALLY_EXCLUSIVE)
+    code = _ladder(code, is_pv & bal, TR.FLAGS_ARE_MUTUALLY_EXCLUSIVE)
+    code = _ladder(code, is_pv & u128.is_zero(b.pending_id), TR.PENDING_ID_MUST_NOT_BE_ZERO)
+    code = _ladder(code, is_pv & u128.is_max(b.pending_id), TR.PENDING_ID_MUST_NOT_BE_INT_MAX)
+    code = _ladder(code, is_pv & u128.eq(b.pending_id, b.id), TR.PENDING_ID_MUST_BE_DIFFERENT)
+    code = _ladder(code, is_pv & (b.timeout != 0), TR.TIMEOUT_RESERVED_FOR_PENDING_TRANSFER)
+    # (host rungs 25-30 merge in here)
+    code = _ladder(
+        code, is_pv & p.found & u128.gt(resolved, p.amount),
+        TR.EXCEEDS_PENDING_TRANSFER_AMOUNT,
+    )
+    code = _ladder(
+        code, is_pv & p.found & void & u128.lt(resolved, p.amount),
+        TR.PENDING_TRANSFER_HAS_DIFFERENT_AMOUNT,
+    )
+    base_posted = p.base_fulfillment == FULFILL_POSTED
+    base_voided = p.base_fulfillment == FULFILL_VOIDED
+    # Dynamic in-batch fulfillment rungs share these codes; the static
+    # (pre-batch) cases fold in here, the in-batch ones in evaluate().
+    code = _ladder(code, is_pv & base_posted, TR.PENDING_TRANSFER_ALREADY_POSTED)
+    code = _ladder(code, is_pv & base_voided, TR.PENDING_TRANSFER_ALREADY_VOIDED)
+    code_pre_expiry = code
+    code = _ladder(code, is_pv & p.found & ts_expired, TR.PENDING_TRANSFER_EXPIRED)
+    return code, code_pre_expiry
 
 
 def _timeout_overflows(b: TransferBatch):
@@ -116,6 +207,14 @@ def _timeout_overflows(b: TransferBatch):
     timeout_ns = u128.mul_u32(b.timeout, jnp.uint32(NS_PER_S))
     _, over = u128.add(b.timestamp, timeout_ns)
     return over
+
+
+def _pending_expired(b: TransferBatch, p: PendingInfo):
+    """p.timeout > 0 and t.timestamp >= p.timestamp + p.timeout * 1e9."""
+    timeout_ns = u128.mul_u32(p.timeout, jnp.uint32(NS_PER_S))
+    deadline, over = u128.add(p.timestamp, timeout_ns)
+    # Overflowed deadline can never be reached.
+    return (p.timeout != 0) & ~over & u128.ge(b.timestamp, deadline)
 
 
 def _seg_exclusive_cumsum(vals_sorted: jnp.ndarray, head_pos: jnp.ndarray):
@@ -146,15 +245,22 @@ def create_transfers_exact_impl(
     state: LedgerState,
     b: TransferBatch,
     host_code: jnp.ndarray,
+    pending: PendingInfo,
+    chain_id: jnp.ndarray,
     max_sweeps: int = MAX_SWEEPS,
 ):
     """Fixed-point commit for order-dependent batches.
 
-    Returns (new_state, codes (n,), amounts (n,4) — post-clamp, dr_after,
-    cr_after (Observed — post-event balances for history rows), bail).
-    `bail` is True when the batch did not stabilize within max_sweeps, an
-    unsupported flag (linked/post/void) is present, or a posting overflow
-    fired — the host must redo the batch serially.
+    chain_id: (n,) i32 — linked-chain segment per event (contiguous;
+    singleton chains for unlinked events). The chain-open failure of an
+    unterminated trailing chain arrives via host_code (the oracle assigns
+    LINKED_EVENT_CHAIN_OPEN before any ladder rung).
+
+    Returns (new_state, codes (n,), amounts (n,4) — post-clamp/resolved,
+    dr_after, cr_after (Observed — post-event balances for history rows),
+    bail). `bail` is True when the batch did not stabilize within
+    max_sweeps or a posting overflow/underflow fired — the host must redo
+    the batch serially.
     """
     n = b.flags.shape[0]
     a_count = state.ledger.shape[0]
@@ -164,9 +270,21 @@ def create_transfers_exact_impl(
     bal_dr = (flags & F_BAL_DR) != 0
     bal_cr = (flags & F_BAL_CR) != 0
     balancing = bal_dr | bal_cr
-    unsupported = (flags & (F_LINKED | F_POST | F_VOID)) != 0
+    is_pv = (flags & (F_POST | F_VOID)) != 0
+    is_post = (flags & F_POST) != 0
 
-    static_code = merge_codes(_static_ladder(state, b), host_code)
+    # Resolved post/void amount: t.amount if > 0 else p.amount
+    # (state_machine.zig:1442; exact only when p is found).
+    resolved_pv = u128.select(u128.is_zero(b.amount), pending.amount, b.amount)
+
+    ts_expired = _pending_expired(b, pending)
+    reg_code = merge_codes(_static_ladder(state, b, is_pv), host_code)
+    pv_code, pv_code_pre_expiry = _pv_static_ladder(
+        b, pending, is_pv, resolved_pv, ts_expired
+    )
+    pv_code = merge_codes(pv_code, host_code)
+    pv_code_pre_expiry = merge_codes(pv_code_pre_expiry, host_code)
+    static_code = jnp.where(is_pv, pv_code, reg_code)
     ts_over = _timeout_overflows(b)
 
     dr_ix = jnp.clip(b.dr_slot, 0, a_max)
@@ -175,58 +293,162 @@ def create_transfers_exact_impl(
     cr_limit = (state.flags[cr_ix] & AF_CREDITS_MUST_NOT_EXCEED_DEBITS) != 0
 
     # Balancing zero-amount sentinel is maxInt(u64), not u128.
-    u64max = jnp.broadcast_to(
-        jnp.array(_U64_MAX_LIMBS, dtype=U32), (n, 4)
-    )
+    u64max = jnp.broadcast_to(jnp.array(_U64_MAX_LIMBS, dtype=U32), (n, 4))
     amount0 = u128.select(balancing & u128.is_zero(b.amount), u64max, b.amount)
+    amount0 = u128.select(is_pv, resolved_pv, amount0)
+
+    # Effective account pair: post/void posts against the PENDING's accounts.
+    eff_dr_slot = jnp.where(is_pv, pending.dr_slot, b.dr_slot).astype(I32)
+    eff_cr_slot = jnp.where(is_pv, pending.cr_slot, b.cr_slot).astype(I32)
 
     # --- static sort of the 2n (slot, event) postings ------------------
-    idx = jnp.arange(n, dtype=jnp.int32)
-    rec_slot = jnp.concatenate([b.dr_slot, b.cr_slot]).astype(jnp.int32)
+    idx = jnp.arange(n, dtype=I32)
+    rec_slot = jnp.concatenate([eff_dr_slot, eff_cr_slot])
     rec_idx = jnp.concatenate([idx, idx])
+    rec_chain = jnp.concatenate([chain_id, chain_id]).astype(I32)
     sort_slot = jnp.where(rec_slot >= 0, rec_slot, jnp.int32(a_count))
-    sorted_slot, _sorted_idx, perm = jax.lax.sort(
-        (sort_slot, rec_idx, jnp.arange(2 * n, dtype=jnp.int32)),
-        num_keys=2,
+    sorted_slot, sorted_chain, _si, perm = jax.lax.sort(
+        (sort_slot, rec_chain, rec_idx, jnp.arange(2 * n, dtype=I32)),
+        num_keys=3,  # chains are idx-contiguous: (slot, chain, idx) == (slot, idx)
         is_stable=True,
     )
     seg_head = jnp.concatenate(
         [jnp.ones((1,), dtype=bool), sorted_slot[1:] != sorted_slot[:-1]]
     )
     head_pos = jax.lax.cummax(
-        jnp.where(seg_head, jnp.arange(2 * n, dtype=jnp.int32), 0)
+        jnp.where(seg_head, jnp.arange(2 * n, dtype=I32), 0)
+    )
+    # (slot, chain) sub-segment heads for the same-chain correction prefix.
+    sub_head = seg_head | jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), sorted_chain[1:] != sorted_chain[:-1]]
+    )
+    sub_head_pos = jax.lax.cummax(
+        jnp.where(sub_head, jnp.arange(2 * n, dtype=I32), 0)
     )
     base = Observed(*[
         getattr(state, f)[jnp.clip(rec_slot, 0, a_max)] for f in BAL_FIELDS
     ])
 
+    # --- fulfillment groups: sort post/void records by (group, idx) -----
+    f_group = jnp.where(is_pv, pending.group, jnp.int32(n)).astype(I32)
+    f_sorted_group, _fi, f_perm = jax.lax.sort(
+        (f_group, idx, jnp.arange(n, dtype=I32)), num_keys=2, is_stable=True
+    )
+    f_head = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), f_sorted_group[1:] != f_sorted_group[:-1]]
+    )
+    f_chain_sorted = chain_id[f_perm]
+    f_sub_head = f_head | jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), f_chain_sorted[1:] != f_chain_sorted[:-1]]
+    )
+    f_head_pos = jax.lax.cummax(jnp.where(f_head, jnp.arange(n, dtype=I32), 0))
+    f_sub_head_pos = jax.lax.cummax(jnp.where(f_sub_head, jnp.arange(n, dtype=I32), 0))
+
     zeros_n8 = jnp.zeros((n, 8), dtype=U32)
 
-    def observe(ok: jnp.ndarray, amount: jnp.ndarray):
-        """Balances each posting record sees given the current speculation."""
-        amt_h = u128.split_u16(amount)  # (n, 8)
-        d_pend = jnp.where((ok & pend)[:, None], amt_h, zeros_n8)
-        d_post = jnp.where((ok & ~pend)[:, None], amt_h, zeros_n8)
-        rec_vals = {
-            "debits_pending": jnp.concatenate([d_pend, zeros_n8]),
-            "debits_posted": jnp.concatenate([d_post, zeros_n8]),
-            "credits_pending": jnp.concatenate([zeros_n8, d_pend]),
-            "credits_posted": jnp.concatenate([zeros_n8, d_post]),
-        }
-        obs = {}
-        for f, vals in rec_vals.items():
-            prefix_sorted = _seg_exclusive_cumsum(vals[perm], head_pos)
-            prefix = jnp.zeros_like(prefix_sorted).at[perm].set(prefix_sorted)
-            delta, _ = u128.combine_u16(prefix)
-            obs[f], _ = u128.add(base._asdict()[f], delta)
-        return Observed(**obs)
+    def chain_all_ok(ok):
+        """(n,) per-event: does every event of my chain currently pass?"""
+        per_chain = jax.ops.segment_min(
+            ok.astype(I32), chain_id, num_segments=n, indices_are_sorted=True
+        )
+        return per_chain[chain_id] != 0
 
-    def evaluate(obs: Observed):
+    def observe(ok, chain_ok_ev, amount):
+        """Balances each posting record sees given the current speculation.
+
+        Cross-chain effects apply when the whole chain passes (mask A,
+        slot segments); same-chain effects of a currently-failing chain
+        are still visible from inside that chain (mask B, (slot, chain)
+        sub-segments). Post/void removes the pending amount from the
+        *_pending fields and (post only) adds the resolved amount to the
+        *_posted fields.
+        """
+        eff = ok & chain_ok_ev
+        own = ok & ~chain_ok_ev
+        amt_h = u128.split_u16(amount)  # (n, 8)
+        p_amt_h = u128.split_u16(pending.amount)
+
+        pend_add = jnp.where((pend & ~is_pv)[:, None], amt_h, zeros_n8)
+        post_add = jnp.where(
+            (~pend & ~is_pv)[:, None] | (is_pv & is_post)[:, None], amt_h, zeros_n8
+        )
+        pend_sub = jnp.where(is_pv[:, None], p_amt_h, zeros_n8)
+
+        # Per-record (2n) streams: dr side first, cr side second.
+        streams = {
+            "debits_pending_add": jnp.concatenate([pend_add, zeros_n8]),
+            "debits_pending_sub": jnp.concatenate([pend_sub, zeros_n8]),
+            "debits_posted_add": jnp.concatenate([post_add, zeros_n8]),
+            "credits_pending_add": jnp.concatenate([zeros_n8, pend_add]),
+            "credits_pending_sub": jnp.concatenate([zeros_n8, pend_sub]),
+            "credits_posted_add": jnp.concatenate([zeros_n8, post_add]),
+        }
+        eff2 = jnp.concatenate([eff, eff])[perm]
+        own2 = jnp.concatenate([own, own])[perm]
+
+        def prefix(vals):
+            vs = vals[perm]
+            a = _seg_exclusive_cumsum(
+                jnp.where(eff2[:, None], vs, 0), head_pos
+            )
+            c = _seg_exclusive_cumsum(
+                jnp.where(own2[:, None], vs, 0), sub_head_pos
+            )
+            total = a + c  # both < 2^16 terms each of < 2^16; sum < 2^32
+            unsorted = jnp.zeros_like(total).at[perm].set(total)
+            delta, _ = u128.combine_u16(unsorted)
+            return delta
+
+        obs = {}
+        under_any = jnp.array(False)
+        for f in BAL_FIELDS:
+            add = prefix(streams[f + "_add"]) if f + "_add" in streams else 0
+            plus, _ = u128.add(base._asdict()[f], add)
+            if f + "_sub" in streams:
+                sub = prefix(streams[f + "_sub"])
+                minus, under = u128.sub(plus, sub)
+                # Saturate during speculation; at the fixed point every
+                # observation equals a serial-prefix balance (non-negative),
+                # so a final-step borrow means inconsistent state → bail.
+                obs[f] = u128.select(under, jnp.zeros_like(minus), minus)
+                under_any = under_any | jnp.any(under)
+            else:
+                obs[f] = plus
+        return Observed(**obs), under_any
+
+    def fulfillment_prefix(ok, chain_ok_ev):
+        """Exclusive per-group OR of earlier successful posts / voids."""
+        eff = ok & chain_ok_ev
+        own = ok & ~chain_ok_ev
+
+        def orpre(mask):
+            v = mask.astype(U32)[f_perm][:, None]
+            a = _seg_exclusive_cumsum(jnp.where(eff[f_perm][:, None] != 0, v, 0), f_head_pos)
+            c = _seg_exclusive_cumsum(jnp.where(own[f_perm][:, None] != 0, v, 0), f_sub_head_pos)
+            total = (a + c)[:, 0]
+            return jnp.zeros((n,), dtype=U32).at[f_perm].set(total) > 0
+
+        earlier_posted = orpre(is_pv & is_post)
+        earlier_voided = orpre(is_pv & ~is_post)
+        return earlier_posted, earlier_voided
+
+    def evaluate(obs: Observed, earlier_posted, earlier_voided):
         """Dynamic ladder given observed balances; returns (code, amount)."""
         dr = Observed(*[x[:n] for x in obs])
         cr = Observed(*[x[n:] for x in obs])
-        code = static_code
         amt = amount0
+
+        # --- post/void dynamic rungs: in-batch fulfillment --------------
+        # Order (oracle): already_posted/voided (incl. in-batch) precede
+        # expired — rebuild from the pre-expiry static code.
+        pv_dyn = _ladder(
+            pv_code_pre_expiry, is_pv & earlier_posted, TR.PENDING_TRANSFER_ALREADY_POSTED
+        )
+        pv_dyn = _ladder(pv_dyn, is_pv & earlier_voided, TR.PENDING_TRANSFER_ALREADY_VOIDED)
+        pv_dyn = _ladder(pv_dyn, is_pv & pending.found & ts_expired, TR.PENDING_TRANSFER_EXPIRED)
+
+        # --- regular dynamic rungs --------------------------------------
+        code = reg_code
 
         # Balancing clamps (state_machine.zig:1286-1306): amount is capped at
         # what the account can absorb without breaching its net balance.
@@ -278,15 +500,24 @@ def create_transfers_exact_impl(
             u128.widen(cr.debits_posted, 5),
         )
         code = _ladder(code, exceed_c, TR.EXCEEDS_DEBITS)
+
+        code = jnp.where(is_pv, pv_dyn, code)
+        amt = u128.select(is_pv, resolved_pv, amt)
         return code, amt
 
     def masked(ok, amount):
         return u128.select(ok, amount, jnp.zeros_like(amount))
 
+    def step(ok, amount):
+        chain_ok_ev = chain_all_ok(ok)
+        obs, under = observe(ok, chain_ok_ev, amount)
+        ep, ev = fulfillment_prefix(ok, chain_ok_ev)
+        code, amt = evaluate(obs, ep, ev)
+        return code, amt, under, chain_ok_ev, obs
+
     def sweep(carry):
         ok, amount, it, _ = carry
-        obs = observe(ok, amount)
-        code, amt = evaluate(obs)
+        code, amt, _, _, _ = step(ok, amount)
         new_ok = code == 0
         stable = jnp.all(new_ok == ok) & jnp.all(masked(new_ok, amt) == masked(ok, amount))
         return new_ok, masked(new_ok, amt), it + 1, stable
@@ -298,23 +529,26 @@ def create_transfers_exact_impl(
     )
 
     # Final consistent evaluation: codes + the balances history rows need.
-    obs = observe(ok, amount)
-    codes, amounts = evaluate(obs)
+    codes, amounts, under_final, chain_ok_ev, obs = step(ok, amount)
+    ok = codes == 0
+    # Linked-chain rollback: a passing event inside a failing chain reports
+    # LINKED_EVENT_FAILED (state_machine.zig:1058-1072).
+    chain_ok_final = chain_all_ok(ok)
+    codes = jnp.where(
+        ok & ~chain_ok_final, jnp.uint32(int(TR.LINKED_EVENT_FAILED)), codes
+    )
     ok = codes == 0
     amounts = masked(ok, amounts)
 
-    new_state, overflow = apply_posting_streamed(
-        state, b.dr_slot, b.cr_slot, amounts,
-        dr_pend=ok & pend, dr_post=ok & ~pend,
-        cr_pend=ok & pend, cr_post=ok & ~pend,
-    )
+    new_state, overflow = _apply(state, b, pending, is_pv, is_post, pend, ok, amounts)
 
     # Post-event balances (observed + own delta) for history rows
-    # (state_machine.zig:1342-1364 snapshots balances after the transfer).
+    # (state_machine.zig:1342-1364 — regular events only; post/void writes
+    # no history row, mirroring the oracle).
     dr_obs = Observed(*[x[:n] for x in obs])
     cr_obs = Observed(*[x[n:] for x in obs])
-    amt_pend = masked(ok & pend, amounts)
-    amt_post = masked(ok & ~pend, amounts)
+    amt_pend = masked(ok & pend & ~is_pv, amounts)
+    amt_post = masked(ok & ~pend & ~is_pv, amounts)
     dr_after = Observed(
         debits_pending=u128.add(dr_obs.debits_pending, amt_pend)[0],
         debits_posted=u128.add(dr_obs.debits_posted, amt_post)[0],
@@ -328,8 +562,38 @@ def create_transfers_exact_impl(
         credits_posted=u128.add(cr_obs.credits_posted, amt_post)[0],
     )
 
-    bail = (~stable) | overflow | jnp.any(unsupported)
+    bail = (~stable) | overflow | under_final
     return new_state, codes, amounts, dr_after, cr_after, bail
+
+
+def _apply(state, b, pending, is_pv, is_post, pend, ok, amounts):
+    """Post the final outcomes: adds via exact scatter-add, pending
+    removals via exact scatter-sub (post/void)."""
+    eff_dr = jnp.where(is_pv, pending.dr_slot, b.dr_slot).astype(I32)
+    eff_cr = jnp.where(is_pv, pending.cr_slot, b.cr_slot).astype(I32)
+
+    add_pend = ok & pend & ~is_pv
+    add_post = ok & ((~pend & ~is_pv) | (is_pv & is_post))
+    sub_pend = ok & is_pv
+
+    new_dp, o1 = u128.scatter_add(state.debits_pending, eff_dr, amounts, add_pend)
+    new_cp, o2 = u128.scatter_add(state.credits_pending, eff_cr, amounts, add_pend)
+    new_dpo, o3 = u128.scatter_add(state.debits_posted, eff_dr, amounts, add_post)
+    new_cpo, o4 = u128.scatter_add(state.credits_posted, eff_cr, amounts, add_post)
+    new_dp, u1 = u128.scatter_sub(new_dp, eff_dr, pending.amount, sub_pend)
+    new_cp, u2 = u128.scatter_sub(new_cp, eff_cr, pending.amount, sub_pend)
+    _, o5 = u128.add(new_dp, new_dpo)
+    _, o6 = u128.add(new_cp, new_cpo)
+    over = (
+        jnp.any(o1) | jnp.any(o2) | jnp.any(o3) | jnp.any(o4)
+        | jnp.any(o5) | jnp.any(o6) | jnp.any(u1) | jnp.any(u2)
+    )
+    return state._replace(
+        debits_pending=new_dp,
+        debits_posted=new_dpo,
+        credits_pending=new_cp,
+        credits_posted=new_cpo,
+    ), over
 
 
 create_transfers_exact = jax.jit(create_transfers_exact_impl, static_argnames=("max_sweeps",))

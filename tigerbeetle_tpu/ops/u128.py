@@ -231,6 +231,29 @@ def scatter_add(table, slots, values, mask):
     return new_table, (over | delta_over)
 
 
+def scatter_sub(table, slots, values, mask):
+    """table (A, W) -= values (n, W) at rows `slots` (n,) where mask (n,).
+
+    Exact wide-integer scatter-subtract (the pending-removal side of
+    post/void, reference state_machine.zig:1480-1486): per-slot totals are
+    accumulated in u16 half-limbs exactly like scatter_add, then subtracted
+    with borrow propagation. Returns (new_table, underflow (A,)) — underflow
+    means a slot's removals exceeded its balance (inconsistent state).
+    """
+    a, w = table.shape
+    n = slots.shape[0]
+    assert n < (1 << 16), "scatter_sub exactness requires n < 2^16"
+    halves = split_u16(values)
+    halves = jnp.where(mask[:, None], halves, jnp.zeros_like(halves))
+    safe_slots = jnp.where(mask, slots, 0).astype(jnp.int32)
+    acc = jnp.zeros((a, 2 * w), dtype=U32).at[safe_slots].add(
+        halves, mode="drop", indices_are_sorted=False, unique_indices=False
+    )
+    delta, delta_over = combine_u16(acc)
+    new_table, under = sub(table, delta)
+    return new_table, (under | delta_over)
+
+
 def to_ints(limbs) -> list[int] | int:
     """Device/host limb array → Python int(s) (test helper)."""
     import numpy as np
