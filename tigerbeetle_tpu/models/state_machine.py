@@ -381,14 +381,28 @@ class StateMachine:
 
         flags16 = events["flags"]
         keys = pack_keys(events["id_lo"], events["id_hi"])
+        is_pv = (flags16 & _PV_FLAGS) != 0
 
-        hard = bool(np.any(flags16 & _SERIAL_TRANSFER_FLAGS))
-        if not hard and n > 1:
-            order = np.lexsort((keys["lo"], keys["hi"]))
-            sk = keys[order]
-            hard = bool(np.any(sk[1:] == sk[:-1]))
+        # Serial-only cases (the exists ladders and same-batch pending
+        # resolution need the store's view of this very batch): duplicate ids
+        # within the batch, ids already stored, or a post/void whose
+        # pending_id is an id created in this batch.
+        hard = False
+        sorted_ids = keys
+        if n > 1:
+            # KEY_DTYPE field order is (hi, lo): structured sort == u128 order.
+            sorted_ids = np.sort(keys)
+            hard = bool(np.any(sorted_ids[1:] == sorted_ids[:-1]))
         if not hard:
             hard = self.transfer_index.contains_any(keys)
+        pv_keys = None
+        if not hard and bool(np.any(is_pv)):
+            pv_keys = pack_keys(
+                events["pending_id_lo"][is_pv], events["pending_id_hi"][is_pv]
+            )
+            ix = np.searchsorted(sorted_ids, pv_keys)
+            ixc = np.minimum(ix, n - 1)
+            hard = bool(np.any((ix < n) & (sorted_ids[ixc] == pv_keys)))
         if hard:
             self.stats["serial_batches"] += 1
             return self._create_transfers_serial(events, timestamp)
@@ -433,22 +447,25 @@ class StateMachine:
         # The device ladder checks RESERVED_FLAG/ID zero/max first; these
         # rungs sit between them and the rest — the nonzero-minimum merge in
         # the kernel puts every rung at its exact precedence position.
-        ladder(dr_zero, TR.DEBIT_ACCOUNT_ID_MUST_NOT_BE_ZERO)
-        ladder(dr_max, TR.DEBIT_ACCOUNT_ID_MUST_NOT_BE_INT_MAX)
-        ladder(cr_zero, TR.CREDIT_ACCOUNT_ID_MUST_NOT_BE_ZERO)
-        ladder(cr_max, TR.CREDIT_ACCOUNT_ID_MUST_NOT_BE_INT_MAX)
-        ladder(same, TR.ACCOUNTS_MUST_BE_DIFFERENT)
+        # Post/void events branch to their own ladder before any of these
+        # rungs (state_machine.zig:1255), so they are masked out.
+        reg = ~is_pv
+        ladder(reg & dr_zero, TR.DEBIT_ACCOUNT_ID_MUST_NOT_BE_ZERO)
+        ladder(reg & dr_max, TR.DEBIT_ACCOUNT_ID_MUST_NOT_BE_INT_MAX)
+        ladder(reg & cr_zero, TR.CREDIT_ACCOUNT_ID_MUST_NOT_BE_ZERO)
+        ladder(reg & cr_max, TR.CREDIT_ACCOUNT_ID_MUST_NOT_BE_INT_MAX)
+        ladder(reg & same, TR.ACCOUNTS_MUST_BE_DIFFERENT)
 
         if self._ops is None:
             return self._create_transfers_numpy_fast(
                 events, ts, keys, dr_slots, cr_slots, host_code
             )
 
-        b, host_code_p = self._device_batch(events, ts, dr_slots, cr_slots, host_code)
         if exact_needed:
             return self._create_transfers_exact(
-                events, ts, keys, dr_slots, cr_slots, b, host_code_p, timestamp
+                events, ts, dr_slots, cr_slots, host_code, timestamp, is_pv, pv_keys
             )
+        b, host_code_p = self._device_batch(events, ts, dr_slots, cr_slots, host_code)
         new_state, codes_dev, bail = self._ops.create_transfers_fast(self.state, b, host_code_p)
         if bool(bail):
             self.stats["bail_batches"] += 1
@@ -497,16 +514,165 @@ class StateMachine:
         )
         return b, host_code_p
 
+    def _exact_prefetch(self, events: np.ndarray, is_pv: np.ndarray, pv_keys):
+        """Host prefetch for post/void events: resolve pending_id against the
+        store and evaluate the store-dependent ladder rungs (codes 25-30)
+        the device cannot (reference prefetch, state_machine.zig:560-655).
+
+        Returns (pv_code, pinfo dict of per-event numpy arrays,
+        pending_recs, p_rec_idx) where p_rec_idx maps each event to its row
+        in pending_recs (-1 for non-post/void or not-found events)."""
+        from tigerbeetle_tpu.ops import commit_exact as ce
+
+        n = len(events)
+        found = np.zeros(n, dtype=bool)
+        amount = np.zeros((n, 4), dtype=np.uint32)
+        p_dr = np.full(n, -1, dtype=np.int32)
+        p_cr = np.full(n, -1, dtype=np.int32)
+        p_ts = np.zeros(n, dtype=np.uint64)
+        p_timeout = np.zeros(n, dtype=np.uint32)
+        base = np.full(n, ce.FULFILL_NONE, dtype=np.int32)
+        group = np.full(n, n, dtype=np.int32)
+        pv_code = np.zeros(n, dtype=np.uint32)
+        p_rec_idx = np.full(n, -1, dtype=np.int64)
+        pending_recs = np.zeros(0, dtype=types.TRANSFER_DTYPE)
+        if not np.any(is_pv):
+            return pv_code, dict(
+                found=found, amount=amount, dr_slot=p_dr, cr_slot=p_cr,
+                timestamp=p_ts, timeout=p_timeout, base_fulfillment=base,
+                group=group,
+            ), pending_recs, p_rec_idx
+
+        pv_ix = np.nonzero(is_pv)[0]
+        assert pv_keys is not None  # dispatcher built it for the hard-check
+        pkeys = pv_keys
+        # Same referenced pending ⇒ same fulfillment group (first successful
+        # post/void wins; ops/commit_exact.fulfillment_prefix).
+        _, inv = np.unique(pkeys, return_inverse=True)
+        group[pv_ix] = inv.astype(np.int32)
+        rows = self.transfer_index.lookup_batch(pkeys)
+        has = rows != NOT_FOUND
+        pv_code[pv_ix[~has]] = np.uint32(int(TR.PENDING_TRANSFER_NOT_FOUND))
+        if np.any(has):
+            hit = pv_ix[has]
+            urows, uinv = np.unique(rows[has].astype(np.int64), return_inverse=True)
+            pending_recs = self.transfer_log.gather(urows)
+            p_rec_idx[hit] = uinv
+            prec = pending_recs[uinv]
+
+            c = np.zeros(len(hit), dtype=np.uint32)
+
+            def fl(cond, result):
+                np.copyto(c, np.uint32(int(result)), where=(c == 0) & cond)
+
+            not_pending = (prec["flags"] & np.uint16(TransferFlags.PENDING)) == 0
+            fl(not_pending, TR.PENDING_TRANSFER_NOT_PENDING)
+            t_dr_nz = (events["debit_account_id_lo"][hit] != 0) | (
+                events["debit_account_id_hi"][hit] != 0
+            )
+            dr_diff = (events["debit_account_id_lo"][hit] != prec["debit_account_id_lo"]) | (
+                events["debit_account_id_hi"][hit] != prec["debit_account_id_hi"]
+            )
+            fl(t_dr_nz & dr_diff, TR.PENDING_TRANSFER_HAS_DIFFERENT_DEBIT_ACCOUNT_ID)
+            t_cr_nz = (events["credit_account_id_lo"][hit] != 0) | (
+                events["credit_account_id_hi"][hit] != 0
+            )
+            cr_diff = (events["credit_account_id_lo"][hit] != prec["credit_account_id_lo"]) | (
+                events["credit_account_id_hi"][hit] != prec["credit_account_id_hi"]
+            )
+            fl(t_cr_nz & cr_diff, TR.PENDING_TRANSFER_HAS_DIFFERENT_CREDIT_ACCOUNT_ID)
+            fl(
+                (events["ledger"][hit] != 0) & (events["ledger"][hit] != prec["ledger"]),
+                TR.PENDING_TRANSFER_HAS_DIFFERENT_LEDGER,
+            )
+            fl(
+                (events["code"][hit] != 0) & (events["code"][hit] != prec["code"]),
+                TR.PENDING_TRANSFER_HAS_DIFFERENT_CODE,
+            )
+            pv_code[hit] = c
+
+            found[hit] = True
+            amount[hit] = types.u64_pair_to_limbs(prec["amount_lo"], prec["amount_hi"])
+            pdr = self.account_index.lookup_batch(
+                pack_keys(prec["debit_account_id_lo"], prec["debit_account_id_hi"])
+            )
+            pcr = self.account_index.lookup_batch(
+                pack_keys(prec["credit_account_id_lo"], prec["credit_account_id_hi"])
+            )
+            p_dr[hit] = np.where(pdr == NOT_FOUND, -1, pdr.astype(np.int64)).astype(np.int32)
+            p_cr[hit] = np.where(pcr == NOT_FOUND, -1, pcr.astype(np.int64)).astype(np.int32)
+            p_ts[hit] = prec["timestamp"]
+            p_timeout[hit] = prec["timeout"]
+            base_u = np.array(
+                [self.posted.get(int(t), ce.FULFILL_NONE) for t in pending_recs["timestamp"]],
+                dtype=np.int32,
+            )
+            base[hit] = base_u[uinv]
+        return pv_code, dict(
+            found=found, amount=amount, dr_slot=p_dr, cr_slot=p_cr,
+            timestamp=p_ts, timeout=p_timeout, base_fulfillment=base, group=group,
+        ), pending_recs, p_rec_idx
+
     def _create_transfers_exact(
-        self, events, ts, keys, dr_slots, cr_slots, b, host_code_p, timestamp
+        self, events, ts, dr_slots, cr_slots, host_code, timestamp, is_pv, pv_keys=None
     ) -> np.ndarray:
         """Order-dependent batches via the fixed-point sweep kernel
-        (ops/commit_exact.py): balancing clamps, limit flags, history."""
+        (ops/commit_exact.py): balancing clamps, limit flags, history,
+        linked chains, and pending post/void."""
         from tigerbeetle_tpu.ops import commit_exact
 
         n = len(events)
+        pv_code, pinfo_np, pending_recs, p_rec_idx = self._exact_prefetch(
+            events, is_pv, pv_keys
+        )
+
+        # Merge the post/void store rungs at their precedence (25-30 sit
+        # between the host ladder's early rungs and the device's late ones).
+        big = np.uint32(0xFFFFFFFF)
+        merged = np.minimum(
+            np.where(host_code == 0, big, host_code),
+            np.where(pv_code == 0, big, pv_code),
+        )
+        host_code = np.where(merged == big, np.uint32(0), merged)
+
+        # Linked-chain segments: contiguous, chain id = head index
+        # (singleton chains for unlinked events). An unterminated trailing
+        # chain fails with CHAIN_OPEN before any other rung (oracle._execute).
+        linked = (events["flags"] & np.uint16(TransferFlags.LINKED)) != 0
+        new_chain = np.ones(n, dtype=bool)
+        if n > 1:
+            new_chain[1:] = ~linked[:-1]
+        chain_id = np.maximum.accumulate(
+            np.where(new_chain, np.arange(n), 0)
+        ).astype(np.int32)
+        if linked[n - 1]:
+            host_code[n - 1] = np.uint32(int(TR.LINKED_EVENT_CHAIN_OPEN))
+
+        b, host_code_p = self._device_batch(events, ts, dr_slots, cr_slots, host_code)
+        n_pad = int(b.flags.shape[0])
+
+        def padp(a, fill):
+            out = np.full((n_pad, *a.shape[1:]), fill, dtype=a.dtype)
+            out[:n] = a
+            return out
+
+        pinfo = commit_exact.PendingInfo(
+            found=padp(pinfo_np["found"], False),
+            amount=padp(pinfo_np["amount"], 0),
+            dr_slot=padp(pinfo_np["dr_slot"], -1),
+            cr_slot=padp(pinfo_np["cr_slot"], -1),
+            timestamp=padp(types.u64_to_limbs(pinfo_np["timestamp"]), 0),
+            timeout=padp(pinfo_np["timeout"], 0),
+            base_fulfillment=padp(pinfo_np["base_fulfillment"], commit_exact.FULFILL_NONE),
+            group=padp(pinfo_np["group"], n_pad),
+        )
+        chain_id_p = np.arange(n_pad, dtype=np.int32)
+        chain_id_p[:n] = chain_id
+
         new_state, codes_dev, amounts_dev, dr_after, cr_after, bail = (
-            commit_exact.create_transfers_exact(self.state, b, host_code_p)
+            commit_exact.create_transfers_exact(
+                self.state, b, host_code_p, pinfo, chain_id_p
+            )
         )
         if bool(bail):
             self.stats["bail_batches"] += 1
@@ -520,16 +686,64 @@ class StateMachine:
         ok = codes == 0
         if np.any(ok):
             # Transfers are stored with their POST-CLAMP amounts
-            # (state_machine.zig:1330 stores t2.amount = clamped).
+            # (state_machine.zig:1330 stores t2.amount = clamped); post/void
+            # records derive their account/ledger/code/user_data fields from
+            # the pending (state_machine.zig:1462-1480, oracle 563-579).
             recs = events[ok].copy()
             recs["timestamp"] = ts[ok]
             recs["amount_lo"] = amt_lo[ok]
             recs["amount_hi"] = amt_hi[ok]
+            sel = is_pv[ok]
+            if np.any(sel):
+                pi = p_rec_idx[ok][sel]
+                assert np.all(pi >= 0), "ok post/void must have resolved its pending"
+                prec = pending_recs[pi]
+                for f in (
+                    "debit_account_id_lo", "debit_account_id_hi",
+                    "credit_account_id_lo", "credit_account_id_hi",
+                ):
+                    recs[f][sel] = prec[f]
+                recs["ledger"][sel] = prec["ledger"]
+                recs["code"][sel] = prec["code"]
+                recs["timeout"][sel] = 0
+                ud128_zero = (recs["user_data_128_lo"][sel] == 0) & (
+                    recs["user_data_128_hi"][sel] == 0
+                )
+                recs["user_data_128_lo"][sel] = np.where(
+                    ud128_zero, prec["user_data_128_lo"], recs["user_data_128_lo"][sel]
+                )
+                recs["user_data_128_hi"][sel] = np.where(
+                    ud128_zero, prec["user_data_128_hi"], recs["user_data_128_hi"][sel]
+                )
+                recs["user_data_64"][sel] = np.where(
+                    recs["user_data_64"][sel] == 0,
+                    prec["user_data_64"], recs["user_data_64"][sel],
+                )
+                recs["user_data_32"][sel] = np.where(
+                    recs["user_data_32"][sel] == 0,
+                    prec["user_data_32"], recs["user_data_32"][sel],
+                )
             self._store_new_transfers(recs)
             self.commit_timestamp = int(ts[ok][-1])
 
+            # Posted-groove updates (reference PostedGroove insert) —
+            # vectorized gathers, Python only for the dict inserts.
+            pv_ok_ix = np.nonzero(ok & is_pv)[0]
+            if len(pv_ok_ix):
+                p_ts_ok = pending_recs["timestamp"][p_rec_idx[pv_ok_ix]]
+                posted_ok = (
+                    events["flags"][pv_ok_ix]
+                    & np.uint16(TransferFlags.POST_PENDING_TRANSFER)
+                ) != 0
+                for t, is_post in zip(p_ts_ok.tolist(), posted_ok.tolist()):
+                    self.posted[t] = (
+                        oracle_mod.FULFILLMENT_POSTED if is_post
+                        else oracle_mod.FULFILLMENT_VOIDED
+                    )
+
             # History rows from the kernel's post-event balances
-            # (state_machine.zig:1342-1364), in event order.
+            # (state_machine.zig:1342-1364), in event order; post/void
+            # writes no history row (mirroring the oracle).
             hist_flag = np.uint32(AccountFlags.HISTORY)
             dr_hist = np.zeros(n, dtype=bool)
             cr_hist = np.zeros(n, dtype=bool)
@@ -537,7 +751,7 @@ class StateMachine:
             cr_valid = cr_slots >= 0
             dr_hist[dr_valid] = (self.acc_flags[dr_slots[dr_valid]] & hist_flag) != 0
             cr_hist[cr_valid] = (self.acc_flags[cr_slots[cr_valid]] & hist_flag) != 0
-            need = ok & (dr_hist | cr_hist)
+            need = ok & (dr_hist | cr_hist) & ~is_pv
             if np.any(need):
                 dr_a = [np.asarray(x)[:n] for x in dr_after]
                 cr_a = [np.asarray(x)[:n] for x in cr_after]

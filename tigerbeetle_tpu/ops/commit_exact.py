@@ -394,6 +394,11 @@ def create_transfers_exact_impl(
             c = _seg_exclusive_cumsum(
                 jnp.where(own2[:, None], vs, 0), sub_head_pos
             )
+            # Fusing the two gather-difference cumsums directly into the add
+            # miscompiles on the axon TPU backend (observed: garbage negative
+            # deltas under jit, correct eagerly) — the barrier pins both
+            # prefix results before combining. Exactness is unaffected.
+            a, c = jax.lax.optimization_barrier((a, c))
             total = a + c  # both < 2^16 terms each of < 2^16; sum < 2^32
             unsorted = jnp.zeros_like(total).at[perm].set(total)
             delta, _ = u128.combine_u16(unsorted)
@@ -425,6 +430,8 @@ def create_transfers_exact_impl(
             v = mask.astype(U32)[f_perm][:, None]
             a = _seg_exclusive_cumsum(jnp.where(eff[f_perm][:, None] != 0, v, 0), f_head_pos)
             c = _seg_exclusive_cumsum(jnp.where(own[f_perm][:, None] != 0, v, 0), f_sub_head_pos)
+            # Same axon fusion hazard as prefix() above — pin before adding.
+            a, c = jax.lax.optimization_barrier((a, c))
             total = (a + c)[:, 0]
             return jnp.zeros((n,), dtype=U32).at[f_perm].set(total) > 0
 
@@ -531,11 +538,22 @@ def create_transfers_exact_impl(
     # Final consistent evaluation: codes + the balances history rows need.
     codes, amounts, under_final, chain_ok_ev, obs = step(ok, amount)
     ok = codes == 0
-    # Linked-chain rollback: a passing event inside a failing chain reports
-    # LINKED_EVENT_FAILED (state_machine.zig:1058-1072).
-    chain_ok_final = chain_all_ok(ok)
+    # Linked-chain rollback (state_machine.zig:1058-1072): serially only the
+    # FIRST failing event of a chain is ever evaluated — it keeps its own
+    # code; every other member (passing or failing) reports
+    # LINKED_EVENT_FAILED. The one exception is the trailing event of an
+    # unterminated chain, which reports LINKED_EVENT_CHAIN_OPEN even in an
+    # already-broken chain (oracle._execute: the chain-open check precedes
+    # the chain_broken substitution).
+    idxs = jnp.arange(n, dtype=I32)
+    fail_pos = jnp.where(~ok, idxs, jnp.int32(n))
+    first_fail = jax.ops.segment_min(
+        fail_pos, chain_id, num_segments=n, indices_are_sorted=True
+    )[chain_id]
+    chain_fails = first_fail < n
+    keep = (idxs == first_fail) | (codes == jnp.uint32(int(TR.LINKED_EVENT_CHAIN_OPEN)))
     codes = jnp.where(
-        ok & ~chain_ok_final, jnp.uint32(int(TR.LINKED_EVENT_FAILED)), codes
+        chain_fails & ~keep, jnp.uint32(int(TR.LINKED_EVENT_FAILED)), codes
     )
     ok = codes == 0
     amounts = masked(ok, amounts)
