@@ -217,21 +217,47 @@ def _pending_expired(b: TransferBatch, p: PendingInfo):
     return (p.timeout != 0) & ~over & u128.ge(b.timestamp, deadline)
 
 
+def _exclusive_cumsum_mxu(vals: jnp.ndarray) -> jnp.ndarray:
+    """(m, k) u32 → exact exclusive prefix sums along axis 0, MXU-tiled.
+
+    XLA's native u32 cumsum lowers poorly on TPU (~2.4 ms for (16k, 48));
+    a strictly-lower-triangular f32 matmul per 128-row tile plus a u32
+    cross-tile offset scan is ~10× faster on the MXU and exact: lanes hold
+    values < 2^16, so per-tile partial sums stay < 128·2^16 = 2^23 < 2^24
+    (the f32 integer-exact range); cross-tile offsets accumulate in u32.
+    """
+    m, k = vals.shape
+    tile = min(128, m)
+    assert m % tile == 0
+    t = m // tile
+    v = vals.reshape(t, tile, k).astype(jnp.float32)
+    tri = jnp.tril(jnp.ones((tile, tile), jnp.float32), -1)
+    # precision=HIGHEST is load-bearing: the TPU MXU default rounds f32
+    # operands to bf16 (8-bit mantissa), which would corrupt any lane value
+    # not bf16-representable. HIGHEST forces exact f32 accumulation.
+    excl = jnp.einsum(
+        "ij,tjk->tik", tri, v,
+        preferred_element_type=jnp.float32, precision=jax.lax.Precision.HIGHEST,
+    ).astype(U32)
+    tile_tot = excl[:, -1, :] + vals.reshape(t, tile, k)[:, -1, :]
+    offs = jnp.cumsum(tile_tot, axis=0, dtype=U32) - tile_tot
+    return (excl + offs[:, None, :]).reshape(m, k)
+
+
 def _seg_exclusive_cumsum(vals_sorted: jnp.ndarray, head_pos: jnp.ndarray):
     """Per-segment exclusive prefix sums along axis 0.
 
     vals_sorted: (m, k) u32 half-limb lanes in segment-sorted order;
     head_pos: (m,) i32 — index of each position's segment head.
-    Lanes hold values < 2^16 and m ≤ 2^16, so the plain cumsum cannot wrap.
+    Lanes hold values < 2^16 and m ≤ 2^16, so the prefix cannot wrap u32.
     """
     m = vals_sorted.shape[0]
     # Exactness bound: m terms of < 2^16 each must not wrap u32 — static
     # shape check, free at trace time (u128.scatter_add asserts the same).
     assert m <= (1 << 16), f"segmented cumsum exactness requires m <= 2^16, got {m}"
-    c = jnp.cumsum(vals_sorted, axis=0, dtype=U32)
-    cpad = jnp.concatenate([jnp.zeros((1, c.shape[1]), dtype=U32), c], axis=0)
-    pos = jnp.arange(m)
-    return cpad[pos] - cpad[head_pos]
+    excl = _exclusive_cumsum_mxu(vals_sorted)
+    # excl[i] = sum(vals[:i]); per-segment exclusive = excl - excl[head].
+    return excl - excl[head_pos]
 
 
 def _add3_wide(a, b, c):
@@ -265,6 +291,7 @@ def create_transfers_exact_impl(
     n = b.flags.shape[0]
     a_count = state.ledger.shape[0]
     a_max = a_count - 1
+    chain_id = jnp.asarray(chain_id).astype(I32)  # scan-composable (tracer-safe)
     flags = b.flags
     pend = (flags & F_PENDING) != 0
     bal_dr = (flags & F_BAL_DR) != 0
@@ -374,44 +401,51 @@ def create_transfers_exact_impl(
         )
         pend_sub = jnp.where(is_pv[:, None], p_amt_h, zeros_n8)
 
-        # Per-record (2n) streams: dr side first, cr side second.
-        streams = {
-            "debits_pending_add": jnp.concatenate([pend_add, zeros_n8]),
-            "debits_pending_sub": jnp.concatenate([pend_sub, zeros_n8]),
-            "debits_posted_add": jnp.concatenate([post_add, zeros_n8]),
-            "credits_pending_add": jnp.concatenate([zeros_n8, pend_add]),
-            "credits_pending_sub": jnp.concatenate([zeros_n8, pend_sub]),
-            "credits_posted_add": jnp.concatenate([zeros_n8, post_add]),
-        }
+        # All six per-record streams stacked into ONE (2n, 48) tensor so the
+        # whole sweep costs two segmented cumsums, not twelve: lanes 0-7
+        # debits_pending_add, 8-15 debits_pending_sub, 16-23
+        # debits_posted_add, 24-31 credits_pending_add, 32-39
+        # credits_pending_sub, 40-47 credits_posted_add. dr-side records
+        # carry the debit lanes, cr-side records the credit lanes.
+        zeros_n24 = jnp.zeros((n, 24), dtype=U32)
+        dr_lanes = jnp.concatenate([pend_add, pend_sub, post_add, zeros_n24], axis=1)
+        cr_lanes = jnp.concatenate([zeros_n24, pend_add, pend_sub, post_add], axis=1)
+        stacked = jnp.concatenate([dr_lanes, cr_lanes], axis=0)  # (2n, 48)
         eff2 = jnp.concatenate([eff, eff])[perm]
         own2 = jnp.concatenate([own, own])[perm]
 
-        def prefix(vals):
-            vs = vals[perm]
-            a = _seg_exclusive_cumsum(
-                jnp.where(eff2[:, None], vs, 0), head_pos
-            )
-            c = _seg_exclusive_cumsum(
-                jnp.where(own2[:, None], vs, 0), sub_head_pos
-            )
-            # Fusing the two gather-difference cumsums directly into the add
-            # miscompiles on the axon TPU backend (observed: garbage negative
-            # deltas under jit, correct eagerly) — the barrier pins both
-            # prefix results before combining. Exactness is unaffected.
-            a, c = jax.lax.optimization_barrier((a, c))
-            total = a + c  # both < 2^16 terms each of < 2^16; sum < 2^32
-            unsorted = jnp.zeros_like(total).at[perm].set(total)
-            delta, _ = u128.combine_u16(unsorted)
-            return delta
+        vs = stacked[perm]
+        a = _seg_exclusive_cumsum(jnp.where(eff2[:, None], vs, 0), head_pos)
+        c = _seg_exclusive_cumsum(jnp.where(own2[:, None], vs, 0), sub_head_pos)
+        # Fusing the two gather-difference cumsums directly into the add
+        # miscompiles on the axon TPU backend (observed: garbage negative
+        # deltas under jit, correct eagerly) — the barrier pins both
+        # prefix results before combining. Exactness is unaffected.
+        a, c = jax.lax.optimization_barrier((a, c))
+        total = a + c  # both < 2^16 terms each of < 2^16; sum < 2^32
+        unsorted = jnp.zeros_like(total).at[perm].set(total)
+
+        # Each 8-lane group's prefix is valid at EVERY record (contributions
+        # are placed only on the contributing side; the segmented sum
+        # accumulates them for all records of the slot).
+        groups = ("dp_add", "dp_sub", "dpo_add", "cp_add", "cp_sub", "cpo_add")
+        deltas = {
+            name: u128.combine_u16(unsorted[:, 8 * i : 8 * i + 8])[0]
+            for i, name in enumerate(groups)
+        }
 
         obs = {}
         under_any = jnp.array(False)
-        for f in BAL_FIELDS:
-            add = prefix(streams[f + "_add"]) if f + "_add" in streams else 0
-            plus, _ = u128.add(base._asdict()[f], add)
-            if f + "_sub" in streams:
-                sub = prefix(streams[f + "_sub"])
-                minus, under = u128.sub(plus, sub)
+        spec = {
+            "debits_pending": ("dp_add", "dp_sub"),
+            "debits_posted": ("dpo_add", None),
+            "credits_pending": ("cp_add", "cp_sub"),
+            "credits_posted": ("cpo_add", None),
+        }
+        for f, (add_name, sub_name) in spec.items():
+            plus, _ = u128.add(base._asdict()[f], deltas[add_name])
+            if sub_name is not None:
+                minus, under = u128.sub(plus, deltas[sub_name])
                 # Saturate during speculation; at the fixed point every
                 # observation equals a serial-prefix balance (non-negative),
                 # so a final-step borrow means inconsistent state → bail.
@@ -422,22 +456,19 @@ def create_transfers_exact_impl(
         return Observed(**obs), under_any
 
     def fulfillment_prefix(ok, chain_ok_ev):
-        """Exclusive per-group OR of earlier successful posts / voids."""
+        """Exclusive per-group OR of earlier successful posts / voids —
+        both masks ride one two-lane prefix pass."""
         eff = ok & chain_ok_ev
         own = ok & ~chain_ok_ev
-
-        def orpre(mask):
-            v = mask.astype(U32)[f_perm][:, None]
-            a = _seg_exclusive_cumsum(jnp.where(eff[f_perm][:, None] != 0, v, 0), f_head_pos)
-            c = _seg_exclusive_cumsum(jnp.where(own[f_perm][:, None] != 0, v, 0), f_sub_head_pos)
-            # Same axon fusion hazard as prefix() above — pin before adding.
-            a, c = jax.lax.optimization_barrier((a, c))
-            total = (a + c)[:, 0]
-            return jnp.zeros((n,), dtype=U32).at[f_perm].set(total) > 0
-
-        earlier_posted = orpre(is_pv & is_post)
-        earlier_voided = orpre(is_pv & ~is_post)
-        return earlier_posted, earlier_voided
+        v = jnp.stack(
+            [(is_pv & is_post).astype(U32), (is_pv & ~is_post).astype(U32)], axis=-1
+        )[f_perm]
+        a = _seg_exclusive_cumsum(jnp.where(eff[f_perm][:, None], v, 0), f_head_pos)
+        c = _seg_exclusive_cumsum(jnp.where(own[f_perm][:, None], v, 0), f_sub_head_pos)
+        # Same axon fusion hazard as prefix() above — pin before adding.
+        a, c = jax.lax.optimization_barrier((a, c))
+        total = jnp.zeros_like(a).at[f_perm].set(a + c)
+        return total[:, 0] > 0, total[:, 1] > 0
 
     def evaluate(obs: Observed, earlier_posted, earlier_voided):
         """Dynamic ladder given observed balances; returns (code, amount)."""
@@ -523,20 +554,28 @@ def create_transfers_exact_impl(
         return code, amt, under, chain_ok_ev, obs
 
     def sweep(carry):
-        ok, amount, it, _ = carry
-        code, amt, _, _, _ = step(ok, amount)
+        ok, amount, it, _, _, _, _ = carry
+        code, amt, under, _, obs = step(ok, amount)
         new_ok = code == 0
         stable = jnp.all(new_ok == ok) & jnp.all(masked(new_ok, amt) == masked(ok, amount))
-        return new_ok, masked(new_ok, amt), it + 1, stable
+        # Carry the step's outputs out of the loop: at the stable fixed
+        # point they ARE the consistent final evaluation (new_ok == ok), so
+        # no post-loop re-evaluation is needed.
+        return new_ok, masked(new_ok, amt), it + 1, stable, code, obs, under
 
     init_ok = static_code == 0
-    init = (init_ok, masked(init_ok, amount0), jnp.int32(0), jnp.array(False))
-    ok, amount, sweeps, stable = jax.lax.while_loop(
+    zero_obs = Observed(*([jnp.zeros((2 * n, 4), dtype=U32)] * 4))
+    init = (
+        init_ok, masked(init_ok, amount0), jnp.int32(0), jnp.array(False),
+        static_code, zero_obs, jnp.array(False),
+    )
+    ok, amount, sweeps, stable, codes, obs, under_final = jax.lax.while_loop(
         lambda c: (~c[3]) & (c[2] < max_sweeps), sweep, init
     )
 
-    # Final consistent evaluation: codes + the balances history rows need.
-    codes, amounts, under_final, chain_ok_ev, obs = step(ok, amount)
+    # At the fixed point the carried codes/amount are the consistent final
+    # evaluation (the loop body's step already re-evaluated them).
+    amounts = amount
     ok = codes == 0
     # Linked-chain rollback (state_machine.zig:1058-1072): serially only the
     # FIRST failing event of a chain is ever evaluated — it keeps its own
