@@ -1,21 +1,19 @@
-"""Benchmark: posted transfers/sec through the batched commit engine.
+"""Benchmark matrix: the five BASELINE.json configs + the end-to-end path.
 
-Reproduces the reference's `tigerbeetle benchmark` workload shape
-(/root/reference/src/tigerbeetle/benchmark_load.zig:13-16 — 10k accounts,
-8190-transfer batches, simple transfers) against this framework's
-device-resident commit engine, and prints ONE JSON line.
+Reproduces the reference's benchmark workload shapes
+(/root/reference/src/tigerbeetle/benchmark_load.zig:13-16, BASELINE.md) and
+prints ONE JSON line. The primary metric stays config 1 (the reference's
+`tigerbeetle benchmark` default: 10k accounts, 8190-transfer batches, simple
+transfers); configs 2-5 and the end-to-end TCP number ride in `extra`.
 
 Measurement design: the dev-environment TPU is reached through a relay
 tunnel with ~6-20 MB/s host↔device bandwidth and 20-100 ms per-transfer
 fixed latency, so any host-driven loop measures the tunnel, not the engine
-(a production replica is colocated with its chip). The benchmark therefore
-keeps the pipeline on-device: batches are generated on-chip (deterministic
-PRNG workload, the analog of benchmark_load's pre-generated id stream) and
-K batches are committed per dispatch via lax.scan; only the aggregate
-posted-count crosses back per timing window. The committed math is the full
-fast-path kernel (validation ladder + exact u128 scatter-add posting +
-overflow bail) — byte-identical semantics to the oracle, enforced by
-tests/test_state_machine.py.
+(a production replica is colocated with its chip). Device configs therefore
+keep the pipeline on-device: batches are generated (or pre-staged) on-chip
+and K batches are committed per dispatch via lax.scan; only aggregates cross
+back per timing window. Config 5 (LSM) and the end-to-end number are
+host-side by nature and measured as such.
 
 vs_baseline is relative to the reference's design-target throughput of
 1,000,000 transfers/sec (docs/FAQ.md:70; the repo publishes no measured
@@ -40,8 +38,81 @@ BATCH = 8190
 SCAN_BATCHES = 64  # batches fused per dispatch
 WINDOWS = 6  # timed dispatches
 
+LSM_ROWS = int(os.environ.get("BENCH_LSM_ROWS", 5_000_000))
+E2E_TRANSFERS = int(os.environ.get("BENCH_E2E_TRANSFERS", 40 * 8190))
 
-def main() -> None:
+
+def _simple_batch_fn(commit_ops, jnp, jax, n, n_accounts, zipf_cdf=None):
+    """Returns a scan-body that generates one batch on device and commits it
+    via the fast kernel. With zipf_cdf (device f32 CDF), account draws are
+    Zipf-skewed (config 2); else uniform (config 1)."""
+
+    def one_batch(carry, i):
+        state, key = carry
+        key, k1, k2, k3 = jax.random.split(key, 4)
+        if zipf_cdf is None:
+            dr = jax.random.randint(k1, (n,), 0, n_accounts, dtype=jnp.int32)
+            cr = jax.random.randint(k2, (n,), 0, n_accounts, dtype=jnp.int32)
+        else:
+            u1 = jax.random.uniform(k1, (n,), dtype=jnp.float32)
+            u2 = jax.random.uniform(k2, (n,), dtype=jnp.float32)
+            dr = jnp.searchsorted(zipf_cdf, u1).astype(jnp.int32)
+            cr = jnp.searchsorted(zipf_cdf, u2).astype(jnp.int32)
+            dr = jnp.clip(dr, 0, n_accounts - 1)
+            cr = jnp.clip(cr, 0, n_accounts - 1)
+        cr = jnp.where(cr == dr, (cr + 1) % n_accounts, cr)
+        amount_lo = jax.random.randint(k3, (n,), 1, 1_000_000, dtype=jnp.int32)
+        zeros = jnp.zeros((n,), dtype=jnp.uint32)
+        lane = jnp.arange(n, dtype=jnp.uint32)
+        b = commit_ops.TransferBatch(
+            id=jnp.stack(
+                [lane + 1, jnp.full((n,), i, dtype=jnp.uint32), zeros, zeros], axis=-1
+            ),
+            dr_slot=dr,
+            cr_slot=cr,
+            amount=jnp.stack(
+                [amount_lo.astype(jnp.uint32), zeros, zeros, zeros], axis=-1
+            ),
+            pending_id=jnp.zeros((n, 4), dtype=jnp.uint32),
+            timeout=zeros,
+            ledger=jnp.ones((n,), dtype=jnp.uint32),
+            code=jnp.full((n,), 7, dtype=jnp.uint32),
+            flags=zeros,
+            timestamp=jnp.stack(
+                [lane + 1, jnp.full((n,), i + 1, dtype=jnp.uint32)], axis=-1
+            ),
+        )
+        state, codes, bail = commit_ops.create_transfers_fast_impl(
+            state, b, jnp.zeros((n,), dtype=jnp.uint32)
+        )
+        return (state, key), ((codes == 0).sum(dtype=jnp.uint32), bail)
+
+    return one_batch
+
+
+def _run_windows(jax, jnp, window, state, key, windows=WINDOWS):
+    """Warm up one dispatch, then time `windows` dispatches."""
+    state_w, key_w, posted, bail = window(state, key, jnp.uint32(0))
+    jax.block_until_ready((state_w, posted))
+    assert not bool(bail)
+    state, key = state_w, key_w
+    posteds, bails = [], []
+    t0 = time.perf_counter()
+    for w in range(windows):
+        state, key, posted, bail = window(
+            state, key, jnp.uint32((w + 1) * SCAN_BATCHES)
+        )
+        posteds.append(posted)
+        bails.append(bail)
+    jax.block_until_ready(state)
+    elapsed = time.perf_counter() - t0
+    total_posted = sum(int(p) for p in posteds)
+    assert not any(bool(b) for b in bails)
+    return total_posted, elapsed
+
+
+def bench_config1():
+    """Default: 10k accounts, uniform, simple transfers, fast kernel."""
     import jax
     import jax.numpy as jnp
 
@@ -56,42 +127,7 @@ def main() -> None:
         np.zeros(N_ACCOUNTS, dtype=np.uint32),
         np.ones(N_ACCOUNTS, dtype=bool),
     )
-
-    n = BATCH
-
-    def one_batch(carry, i):
-        state, key = carry
-        key, k1, k2, k3 = jax.random.split(key, 4)
-        dr = jax.random.randint(k1, (n,), 0, N_ACCOUNTS, dtype=jnp.int32)
-        cr = jax.random.randint(k2, (n,), 0, N_ACCOUNTS, dtype=jnp.int32)
-        cr = jnp.where(cr == dr, (cr + 1) % N_ACCOUNTS, cr)
-        amount_lo = jax.random.randint(k3, (n,), 1, 1_000_000, dtype=jnp.int32)
-        zeros = jnp.zeros((n,), dtype=jnp.uint32)
-        lane = jnp.arange(n, dtype=jnp.uint32)
-        b = commit_ops.TransferBatch(
-            # unique nonzero ids: limb0 = lane+1, limb1 = batch counter
-            id=jnp.stack(
-                [lane + 1, jnp.full((n,), i, dtype=jnp.uint32), zeros, zeros], axis=-1
-            ),
-            dr_slot=dr,
-            cr_slot=cr,
-            amount=jnp.stack(
-                [amount_lo.astype(jnp.uint32), zeros, zeros, zeros], axis=-1
-            ),
-            pending_id=jnp.zeros((n, 4), dtype=jnp.uint32),
-            timeout=zeros,
-            ledger=jnp.ones((n,), dtype=jnp.uint32),
-            code=jnp.full((n,), 7, dtype=jnp.uint32),
-            flags=zeros,
-            # strictly increasing, far from u64 overflow
-            timestamp=jnp.stack(
-                [lane + 1, jnp.full((n,), i + 1, dtype=jnp.uint32)], axis=-1
-            ),
-        )
-        state, codes, bail = commit_ops.create_transfers_fast_impl(
-            state, b, jnp.zeros((n,), dtype=jnp.uint32)
-        )
-        return (state, key), ((codes == 0).sum(dtype=jnp.uint32), bail)
+    one_batch = _simple_batch_fn(commit_ops, jnp, jax, BATCH, N_ACCOUNTS)
 
     @jax.jit
     def window(state, key, base):
@@ -101,44 +137,416 @@ def main() -> None:
         return state, key, posted.sum(dtype=jnp.uint32), bails.any()
 
     key = jax.random.PRNGKey(0xBEE)
-    # warmup / compile
-    state_w, key_w, posted, bail = window(state, key, jnp.uint32(0))
-    jax.block_until_ready((state_w, posted))
-    assert not bool(bail)
-    state, key = state_w, key_w
+    total_posted, elapsed = _run_windows(jax, jnp, window, state, key)
+    batches = WINDOWS * SCAN_BATCHES
+    return {
+        "posted_per_s": round(total_posted / elapsed, 1),
+        "batch_ms_avg": round(elapsed / batches * 1e3, 3),
+        "batches": batches,
+        "accounts": N_ACCOUNTS,
+    }
 
-    posteds, bails = [], []
-    t0 = time.perf_counter()
-    for w in range(WINDOWS):
-        state, key, posted, bail = window(
-            state, key, jnp.uint32((w + 1) * SCAN_BATCHES)
+
+def bench_config2_zipf():
+    """Config 2: 1M accounts, Zipf(1.1) hot-account skew (contended
+    scatter-add), fast kernel."""
+    import jax
+    import jax.numpy as jnp
+
+    from tigerbeetle_tpu.ops import commit as commit_ops
+
+    n_accounts = 1_000_000
+    state = commit_ops.init_state(1 << 20)
+    state = commit_ops.register_accounts(
+        state,
+        np.arange(n_accounts, dtype=np.int32),
+        np.ones(n_accounts, dtype=np.uint32),
+        np.zeros(n_accounts, dtype=np.uint32),
+        np.ones(n_accounts, dtype=bool),
+    )
+    # Zipf(s=1.1) inverse-CDF table (f32; tail resolution is ample for a
+    # throughput benchmark — the head carries the contention).
+    k = np.arange(1, n_accounts + 1, dtype=np.float64)
+    w = k ** -1.1
+    cdf = np.cumsum(w)
+    cdf /= cdf[-1]
+    zipf_cdf = jnp.asarray(cdf.astype(np.float32))
+
+    one_batch = _simple_batch_fn(
+        commit_ops, jnp, jax, BATCH, n_accounts, zipf_cdf=zipf_cdf
+    )
+
+    @jax.jit
+    def window(state, key, base):
+        (state, key), (posted, bails) = jax.lax.scan(
+            one_batch, (state, key), base + jnp.arange(SCAN_BATCHES, dtype=jnp.uint32)
         )
-        posteds.append(posted)
-        bails.append(bail)
-    jax.block_until_ready(state)
-    elapsed = time.perf_counter() - t0
-    # The posted counts were produced on-device inside the timed windows;
-    # fetching them after the clock stops costs only the D2H round trips.
-    total_posted = sum(int(p) for p in posteds)
-    assert not any(bool(b) for b in bails)
+        return state, key, posted.sum(dtype=jnp.uint32), bails.any()
 
-    txs = WINDOWS * SCAN_BATCHES * BATCH
-    posted_per_s = total_posted / elapsed
-    batch_ms = elapsed / (WINDOWS * SCAN_BATCHES) * 1e3
+    key = jax.random.PRNGKey(0x21F)
+    total_posted, elapsed = _run_windows(jax, jnp, window, state, key, windows=4)
+    batches = 4 * SCAN_BATCHES
+    return {
+        "posted_per_s": round(total_posted / elapsed, 1),
+        "batch_ms_avg": round(elapsed / batches * 1e3, 3),
+        "accounts": n_accounts,
+        "zipf_s": 1.1,
+    }
+
+
+def _staged_exact_inputs(mix: str, n_accounts: int, scan_iters: int):
+    """Build one staged 8190-event batch for the exact kernel.
+
+    mix='config3': ~20% linked chains (len 2-4), 15% pending creates, 10%
+    post/void of fabricated prior pendings, rest simple. mix='config4':
+    50% balancing transfers, rest simple, no chains/pendings.
+
+    Post/void pendings are synthetic: their amounts are pre-charged into
+    the *_pending balances scan_iters times so every scan iteration can
+    re-post them (each iteration stands for a fresh set of identically-
+    shaped pendings).
+    """
+    import jax.numpy as jnp
+
+    from tigerbeetle_tpu.ops import commit_exact
+
+    rng = np.random.default_rng(0xC0FFEE if mix == "config3" else 0xBA1)
+    n = BATCH
+    n_pad = 8192
+    dr = rng.integers(0, n_accounts, n).astype(np.int32)
+    cr = rng.integers(0, n_accounts, n).astype(np.int32)
+    cr = np.where(cr == dr, (cr + 1) % n_accounts, cr).astype(np.int32)
+    amount = rng.integers(1, 1000, n).astype(np.uint32)
+    flags = np.zeros(n, dtype=np.uint32)
+    chain_id = np.arange(n_pad, dtype=np.int32)
+
+    p_found = np.zeros(n, dtype=bool)
+    p_amount = np.zeros((n, 4), dtype=np.uint32)
+    p_dr = np.full(n, -1, dtype=np.int32)
+    p_cr = np.full(n, -1, dtype=np.int32)
+    p_group = np.full(n, n_pad, dtype=np.int32)
+
+    if mix == "config4":
+        bal = rng.random(n) < 0.5
+        flags[bal] = np.where(
+            rng.random(bal.sum()) < 0.5,
+            np.uint32(commit_exact.F_BAL_DR),
+            np.uint32(commit_exact.F_BAL_CR),
+        )
+    else:
+        i = 0
+        while i < n:
+            r = rng.random()
+            if r < 0.2 and i + 4 < n:  # linked chain
+                clen = int(rng.integers(2, 5))
+                for j in range(clen):
+                    if j < clen - 1:
+                        flags[i + j] = np.uint32(1)  # LINKED
+                    chain_id[i + j] = i
+                i += clen
+            elif r < 0.35:
+                flags[i] = np.uint32(commit_exact.F_PENDING)
+                i += 1
+            elif r < 0.45:  # post/void of a fabricated pending
+                flags[i] = np.uint32(
+                    commit_exact.F_POST if rng.random() < 0.6 else commit_exact.F_VOID
+                )
+                p_found[i] = True
+                p_amount[i, 0] = amount[i]  # void requires equal amounts
+                p_dr[i] = dr[i]
+                p_cr[i] = cr[i]
+                p_group[i] = i
+                i += 1
+            else:
+                i += 1
+
+    def pad(a, fill=0):
+        out = np.full((n_pad, *a.shape[1:]), fill, dtype=a.dtype)
+        out[:n] = a
+        return out
+
+    lane = np.arange(n_pad, dtype=np.uint32)
+    amount_limbs = np.zeros((n, 4), dtype=np.uint32)
+    amount_limbs[:, 0] = amount
+    b = commit_exact.TransferBatch(
+        id=np.stack([lane + 1, np.full(n_pad, 7, np.uint32),
+                     np.zeros(n_pad, np.uint32), np.zeros(n_pad, np.uint32)], axis=-1),
+        dr_slot=pad(dr, fill=-1),
+        cr_slot=pad(cr, fill=-1),
+        amount=pad(amount_limbs),
+        pending_id=np.where(
+            pad(p_found)[:, None],
+            np.stack([lane + 1, np.full(n_pad, 9, np.uint32),
+                      np.zeros(n_pad, np.uint32), np.zeros(n_pad, np.uint32)], axis=-1),
+            np.zeros((n_pad, 4), dtype=np.uint32),
+        ),
+        timeout=np.zeros(n_pad, dtype=np.uint32),
+        ledger=pad(np.ones(n, dtype=np.uint32)),
+        code=pad(np.full(n, 7, dtype=np.uint32)),
+        flags=pad(flags),
+        timestamp=np.stack(
+            [lane + 1, np.full(n_pad, 1000, np.uint32)], axis=-1
+        ),
+    )
+    host_code = np.zeros(n_pad, dtype=np.uint32)
+    host_code[n:] = 5  # padding events carry a nonzero code (never applied)
+    pending = commit_exact.PendingInfo(
+        found=pad(p_found),
+        amount=pad(p_amount),
+        dr_slot=pad(p_dr, fill=-1),
+        cr_slot=pad(p_cr, fill=-1),
+        timestamp=np.zeros((n_pad, 2), dtype=np.uint32),
+        timeout=np.zeros(n_pad, dtype=np.uint32),
+        base_fulfillment=np.full(n_pad, commit_exact.FULFILL_NONE, dtype=np.int32),
+        group=pad(p_group, fill=n_pad),
+    )
+    # Pre-charge pending balances for the fabricated pendings.
+    precharge_dr = np.zeros(n_accounts, dtype=np.uint64)
+    precharge_cr = np.zeros(n_accounts, dtype=np.uint64)
+    for i in np.nonzero(p_found)[0]:
+        precharge_dr[p_dr[i]] += int(p_amount[i, 0]) * scan_iters
+        precharge_cr[p_cr[i]] += int(p_amount[i, 0]) * scan_iters
+    return b, host_code, pending, chain_id, precharge_dr, precharge_cr
+
+
+def bench_exact(mix: str):
+    """Configs 3/4: order-dependent workloads through the fixed-point sweep
+    kernel (ops/commit_exact.py), device-resident."""
+    import jax
+    import jax.numpy as jnp
+
+    from tigerbeetle_tpu.ops import commit as commit_ops
+    from tigerbeetle_tpu.ops import commit_exact
+
+    n_accounts = N_ACCOUNTS
+    K = 16
+    state = commit_ops.init_state(1 << 14)
+    flags = np.zeros(n_accounts, dtype=np.uint32)
+    if mix == "config4":
+        # 25% of accounts carry a must_not_exceed limit flag.
+        flags[::4] = np.uint32(commit_ops.AF_DEBITS_MUST_NOT_EXCEED_CREDITS)
+    state = commit_ops.register_accounts(
+        state,
+        np.arange(n_accounts, dtype=np.int32),
+        np.ones(n_accounts, dtype=np.uint32),
+        flags,
+        np.ones(n_accounts, dtype=bool),
+    )
+    b, host_code, pending, chain_id, pre_dr, pre_cr = _staged_exact_inputs(
+        mix, n_accounts, scan_iters=K * 8
+    )
+    # Seed balances so balancing clamps/limits have room, and pre-charge the
+    # fabricated pendings.
+    seed = np.zeros((1 << 14, 4), dtype=np.uint32)
+    seed[:n_accounts, 0] = 50_000_000
+    seed[:n_accounts, 1] = 50_000_000 >> 32
+    dp = np.zeros((1 << 14, 4), dtype=np.uint32)
+    cp = np.zeros((1 << 14, 4), dtype=np.uint32)
+    dp[:n_accounts, 0] = pre_dr & 0xFFFFFFFF
+    dp[:n_accounts, 1] = pre_dr >> 32
+    cp[:n_accounts, 0] = pre_cr & 0xFFFFFFFF
+    cp[:n_accounts, 1] = pre_cr >> 32
+    state = state._replace(
+        debits_posted=jnp.asarray(seed), credits_posted=jnp.asarray(seed),
+        debits_pending=jnp.asarray(dp), credits_pending=jnp.asarray(cp),
+    )
+    b = jax.tree.map(jnp.asarray, b)
+    pending = jax.tree.map(jnp.asarray, pending)
+    host_code = jnp.asarray(host_code)
+    chain_id = jnp.asarray(chain_id)
+
+    @jax.jit
+    def window(state):
+        def body(st, _):
+            st2, codes, amounts, dra, cra, bail = (
+                commit_exact.create_transfers_exact_impl(
+                    st, b, host_code, pending, chain_id
+                )
+            )
+            return st2, ((codes == 0).sum(dtype=jnp.uint32), bail)
+
+        st, (posted, bails) = jax.lax.scan(body, state, None, length=K)
+        return st, posted.sum(dtype=jnp.uint32), bails.any()
+
+    st, posted, bail = window(state)
+    jax.block_until_ready(st)
+    assert not bool(bail), f"{mix}: warmup bailed"
+    windows = 4
+    t0 = time.perf_counter()
+    total = 0
+    for _ in range(windows):
+        st, posted, bail = window(st)
+        total += int(posted)
+    jax.block_until_ready(st)
+    elapsed = time.perf_counter() - t0
+    assert not bool(bail)
+    batches = windows * K
+    return {
+        # posted counts OK outcomes only; events rate is the processing
+        # throughput (limit/balancing workloads saturate accounts over the
+        # run, so failures are semantic outcomes, not lost work).
+        "posted_per_s": round(total / elapsed, 1),
+        "events_per_s": round(batches * BATCH / elapsed, 1),
+        "batch_ms_avg": round(elapsed / batches * 1e3, 3),
+        "accounts": n_accounts,
+        "kernel": "exact_sweep",
+    }
+
+
+def bench_config5_lsm():
+    """Config 5: LSM ingest + forced major compaction (host tier over a
+    file-backed grid) + the device streaming-merge kernel in isolation."""
+    import shutil
+    import tempfile
+
+    from tigerbeetle_tpu.io.grid import Grid
+    from tigerbeetle_tpu.io.storage import FileStorage
+    from tigerbeetle_tpu.lsm.store import pack_keys
+    from tigerbeetle_tpu.lsm.tree import DurableIndex
+
+    rows = LSM_ROWS
+    block_size = 1 << 18
+    # entries: 20 B each → size the grid with ~2.2x headroom for levels.
+    blocks = max(1 << 10, int(rows * 20 * 2.6 / block_size))
+    tmp = tempfile.mkdtemp(prefix="tbtpu-bench-")
+    out = {}
+    try:
+        storage = FileStorage(
+            os.path.join(tmp, "grid.dat"), size=blocks * block_size, create=True
+        )
+        grid = Grid(storage, 0, blocks, block_size, cache_blocks=16)
+        tree = DurableIndex(grid, unique=True, memtable_max=1 << 17)
+        rng = np.random.default_rng(5)
+        t0 = time.perf_counter()
+        written = 0
+        while written < rows:
+            nb = min(BATCH * 4, rows - written)
+            keys = pack_keys(
+                rng.integers(0, 1 << 63, nb, dtype=np.uint64),
+                rng.integers(0, 1 << 63, nb, dtype=np.uint64),
+            )
+            tree.insert_batch(keys, np.arange(written, written + nb, dtype=np.uint32))
+            written += nb
+        ingest_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        tree.compact_all()
+        storage.sync()
+        compact_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        q = pack_keys(
+            rng.integers(0, 1 << 63, BATCH, dtype=np.uint64),
+            rng.integers(0, 1 << 63, BATCH, dtype=np.uint64),
+        )
+        tree.lookup_batch(q)
+        lookup_s = time.perf_counter() - t0
+        out = {
+            "rows": rows,
+            "ingest_rows_per_s": round(rows / ingest_s, 1),
+            "major_compaction_rows_per_s": round(tree.count / compact_s, 1),
+            "lookup_batch_ms": round(lookup_s * 1e3, 2),
+            "grid_bytes": blocks * block_size,
+        }
+        storage.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # Device streaming-merge kernel in isolation (north-star part 2).
+    import jax
+    import jax.numpy as jnp
+
+    from tigerbeetle_tpu.ops.merge import merge_kernel
+
+    m = 1 << 17
+    rng = np.random.default_rng(6)
+    ka = np.sort(rng.integers(0, 1 << 31, m, dtype=np.int64)).astype(np.uint32)
+    kb = np.sort(rng.integers(0, 1 << 31, m, dtype=np.int64)).astype(np.uint32)
+    keys_a = np.zeros((m, 4), dtype=np.uint32)
+    keys_a[:, 0] = ka
+    keys_b = np.zeros((m, 4), dtype=np.uint32)
+    keys_b[:, 0] = kb
+    va = np.arange(m, dtype=np.uint32)
+    ja, jb = jnp.asarray(keys_a), jnp.asarray(keys_b)
+    jva = jnp.asarray(va)
+
+    # Timing note: block_until_ready on axon is only reliable for array
+    # outputs (scalar sync can return early), so block on the merged arrays
+    # and keep the dispatch queue full with sequential calls.
+    ok, ov = merge_kernel(ja, jva, jb, jva)
+    np.asarray(ov)  # force warmup completion
+    reps = 8
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        ok, ov = merge_kernel(ja, jva, jb, jva)
+    jax.block_until_ready((ok, ov))
+    dt = (time.perf_counter() - t0) / reps
+    out["device_merge_rows_per_s"] = round(2 * m / dt, 1)
+    return out
+
+
+def bench_e2e():
+    """End-to-end: client → TCP → VSR → WAL → state machine, single replica
+    on this host (numpy backend: the device sits behind a high-latency
+    tunnel in this environment; a production replica is chip-colocated)."""
+    import re
+    import subprocess
+
+    env = dict(os.environ)
+    port = 3900 + os.getpid() % 900  # avoid stale-listener collisions
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tigerbeetle_tpu.cli", "benchmark",
+            "--accounts=10000", f"--transfers={E2E_TRANSFERS}",
+            "--backend=numpy", f"--port={port}", "--queries=100",
+        ],
+        capture_output=True, text=True, timeout=900, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    out = {}
+    for line in proc.stdout.splitlines():
+        m = re.match(r"load accepted = ([\d,]+) tx/s", line)
+        if m:
+            out["load_accepted_tx_per_s"] = float(m.group(1).replace(",", ""))
+        m = re.match(r"batch latency p50 = ([\d.]+) ms", line)
+        if m:
+            out["batch_p50_ms"] = float(m.group(1))
+        m = re.match(r"batch latency p90 = ([\d.]+) ms", line)
+        if m:
+            out["batch_p90_ms"] = float(m.group(1))
+        m = re.match(r"query latency p90 = ([\d.]+) ms", line)
+        if m:
+            out["query_p90_ms"] = float(m.group(1))
+    if not out:
+        out["error"] = (proc.stdout + proc.stderr)[-400:]
+    return out
+
+
+def main() -> None:
+    t_start = time.perf_counter()
+    results = {}
+    for name, fn in (
+        ("config1_default", bench_config1),
+        ("config2_zipf", bench_config2_zipf),
+        ("config3_linked_pending", lambda: bench_exact("config3")),
+        ("config4_balancing_limits", lambda: bench_exact("config4")),
+        ("config5_lsm", bench_config5_lsm),
+        ("end_to_end", bench_e2e),
+    ):
+        try:
+            results[name] = fn()
+        except Exception as e:  # noqa: BLE001 — a config failure must not kill the matrix
+            results[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+
+    primary = results.get("config1_default", {})
+    posted_per_s = float(primary.get("posted_per_s", 0.0))
+    results["bench_wall_s"] = round(time.perf_counter() - t_start, 1)
     print(
         json.dumps(
             {
                 "metric": "posted_transfers_per_sec",
-                "value": round(posted_per_s, 1),
+                "value": posted_per_s,
                 "unit": "tx/s",
                 "vs_baseline": round(posted_per_s / BASELINE_TPS, 3),
-                "extra": {
-                    "batch_ms_avg": round(batch_ms, 3),
-                    "batches": WINDOWS * SCAN_BATCHES,
-                    "batch_size": BATCH,
-                    "offered": txs,
-                    "accounts": N_ACCOUNTS,
-                },
+                "extra": results,
             }
         )
     )

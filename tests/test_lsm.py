@@ -20,7 +20,7 @@ from tigerbeetle_tpu.io.grid import FreeSet, Grid, MemGrid
 from tigerbeetle_tpu.io.storage import FileStorage, MemStorage
 from tigerbeetle_tpu.lsm.log import DurableLog
 from tigerbeetle_tpu.lsm.store import NOT_FOUND, pack_keys
-from tigerbeetle_tpu.lsm.tree import DurableIndex, _keys_to_limbs, _limbs_to_keys
+from tigerbeetle_tpu.lsm.tree import DurableIndex
 from tigerbeetle_tpu.ops import merge as merge_ops
 
 
@@ -74,24 +74,38 @@ class TestFreeSet:
 class TestMergeKernel:
     @pytest.mark.parametrize("seed", range(5))
     def test_device_host_byte_equality(self, seed):
+        from tigerbeetle_tpu.lsm.store import sort_lo_major
+
         rng = np.random.default_rng(seed)
         n, m = int(rng.integers(1, 400)), int(rng.integers(1, 400))
-        ka = np.sort(rng.integers(0, 1 << 48, n).astype(np.uint64))
-        kb = np.sort(rng.integers(0, 1 << 48, m).astype(np.uint64))
-        a_keys = pack_keys(ka, (ka >> np.uint64(13)).astype(np.uint64))
-        b_keys = pack_keys(kb, (kb >> np.uint64(13)).astype(np.uint64))
-        a_keys = np.sort(a_keys, kind="stable")
-        b_keys = np.sort(b_keys, kind="stable")
+        ka = rng.integers(0, 1 << 48, n).astype(np.uint64)
+        kb = rng.integers(0, 1 << 48, m).astype(np.uint64)
+        a_keys = pack_keys(ka, rng.integers(0, 1 << 32, n).astype(np.uint64))
+        b_keys = pack_keys(kb, rng.integers(0, 1 << 32, m).astype(np.uint64))
+        a_keys = a_keys[sort_lo_major(a_keys)]
+        b_keys = b_keys[sort_lo_major(b_keys)]
         va = rng.integers(0, 1 << 31, n).astype(np.uint32)
         vb = rng.integers(0, 1 << 31, m).astype(np.uint32)
 
         hk, hv = merge_ops.merge_host(a_keys, va, b_keys, vb)
-        dk_limbs, dv = merge_ops.merge_device(
-            _keys_to_limbs(a_keys), va, _keys_to_limbs(b_keys), vb
-        )
-        dk = _limbs_to_keys(dk_limbs)
+        dk, dv = merge_ops.merge_device(a_keys, va, b_keys, vb)
         assert hk.tobytes() == dk.tobytes()
         assert hv.tobytes() == dv.tobytes()
+
+    def test_lo_max_keys_not_confused_with_padding(self):
+        # A real key whose lo is all-ones must survive the padded device
+        # merge (the pad flag, not a sentinel key value, marks padding).
+        lo_max = np.uint64(0xFFFFFFFFFFFFFFFF)
+        ka = pack_keys(np.array([5, lo_max], dtype=np.uint64),
+                       np.array([0, 3], dtype=np.uint64))
+        kb = pack_keys(np.array([7], dtype=np.uint64), np.array([0], dtype=np.uint64))
+        va = np.array([1, 2], dtype=np.uint32)
+        vb = np.array([10], dtype=np.uint32)
+        hk, hv = merge_ops.merge_host(ka, va, kb, vb)
+        dk, dv = merge_ops.merge_device(ka, va, kb, vb)
+        assert hk.tobytes() == dk.tobytes()
+        assert list(hv) == [1, 10, 2]
+        assert list(dv) == [1, 10, 2]
 
     def test_stability_duplicates_across_runs(self):
         # Equal keys: A-side (older) values must precede B-side values.
@@ -101,7 +115,7 @@ class TestMergeKernel:
         vb = np.array([10, 20, 30], dtype=np.uint32)
         hk, hv = merge_ops.merge_host(ka, va, kb, vb)
         assert list(hv) == [1, 2, 10, 3, 20, 30]
-        dk, dv = merge_ops.merge_device(_keys_to_limbs(ka), va, _keys_to_limbs(kb), vb)
+        dk, dv = merge_ops.merge_device(ka, va, kb, vb)
         assert list(dv) == [1, 2, 10, 3, 20, 30]
 
 

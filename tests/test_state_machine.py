@@ -160,6 +160,20 @@ class TestSerialPath:
         sm, orc = run_both([accounts], [transfers])
         assert sm.stats["exact_batches"] == 1  # linked chains run on-device (r3)
 
+    def test_duplicate_ids_nonadjacent_after_lo_sort(self):
+        # Regression: ids (hi=1,lo=5),(hi=2,lo=5),(hi=1,lo=5) — a lo-only
+        # stable sort leaves the duplicates non-adjacent; the dup check
+        # must still route the batch serial for the exists ladder.
+        accounts = simple_accounts(2)
+        t = []
+        for hi in (1, 2, 1):
+            rec = types.transfer(id=5 | (hi << 64), debit_account_id=1,
+                                 credit_account_id=2, amount=3, ledger=1, code=1)
+            t.append(rec)
+        sm, orc = run_both([accounts], [types.batch(t, types.TRANSFER_DTYPE)])
+        assert sm.stats["serial_batches"] == 1
+        assert 5 | (1 << 64) in orc.transfers
+
     def test_pending_post_void(self):
         accounts = simple_accounts(2)
         P = TransferFlags.PENDING

@@ -279,8 +279,25 @@ def cmd_benchmark(args) -> int:
             print(f"load accepted = {sent / dt:,.0f} tx/s")
             print(f"batch latency p50 = {lat[len(lat) // 2] * 1e3:.2f} ms")
             print(f"batch latency p90 = {lat[int(len(lat) * 0.9)] * 1e3:.2f} ms")
+
+            # Query phase (reference benchmark_load.zig: account queries
+            # after the load; prints query latency p90).
+            if args.queries:
+                qlat = []
+                for qi in range(args.queries):
+                    aid = int(rng.integers(1, args.accounts + 1))
+                    q0 = time.perf_counter()
+                    client.get_account_transfers(aid, limit=100)
+                    qlat.append(time.perf_counter() - q0)
+                qlat.sort()
+                print(f"query latency p90 = {qlat[int(len(qlat) * 0.9)] * 1e3:.2f} ms")
         finally:
             proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
     return 0
 
 
@@ -318,6 +335,7 @@ def main(argv=None) -> int:
     b.add_argument("--transfers", type=int, default=100_000)
     b.add_argument("--batch", type=int, default=8190)
     b.add_argument("--port", type=int, default=3001)
+    b.add_argument("--queries", type=int, default=100)
     b.add_argument("--config", default="production")
     b.add_argument("--backend", default="jax", choices=["jax", "numpy"])
     b.set_defaults(fn=cmd_benchmark)

@@ -5,10 +5,14 @@ immutable-run → merge shape (reference lsm/tree.zig), but bounded by
 accounts_max so it never spills — the account id → slot map is read on
 every batch's prefetch and stays hot.
 
-Keys are u128 as structured (hi, lo) u64 pairs — numpy's structured compare
-gives exact lexicographic == numeric u128 order (no byte-string trailing-NUL
-pitfalls). All lookups are batch APIs (vectorized over whole 8190-event
-batches), matching the reference's prefetch design (groove.zig:644-909).
+Keys are u128 as structured (hi, lo) u64 pairs at the API, but runs are
+ordered **lo-major** internally: numpy sorts/searches on a single u64
+column run ~7x faster than structured-void comparisons, and these indexes
+serve only point lookups (the reference's id tree, groove.zig:48), so any
+total order works. Equal-lo ties (vanishingly rare for id keys) are
+resolved by a bounded forward scan that verifies `hi`. All lookups are
+batch APIs (vectorized over whole 8190-event batches), matching the
+reference's prefetch design (groove.zig:644-909).
 """
 
 from __future__ import annotations
@@ -22,24 +26,66 @@ NOT_FOUND = np.uint32(0xFFFFFFFF)
 
 
 def pack_keys(lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
-    """(n,) u64 lo + hi → (n,) KEY_DTYPE with numeric u128 ordering."""
+    """(n,) u64 lo + hi → (n,) KEY_DTYPE."""
     out = np.empty(len(lo), dtype=KEY_DTYPE)
     out["hi"] = hi
     out["lo"] = lo
     return out
 
 
+def sort_lo_major(keys: np.ndarray) -> np.ndarray:
+    """Stable argsort by the lo column (ties keep insertion order)."""
+    return np.argsort(keys["lo"], kind="stable")
+
+
+def search_run(
+    run_keys: np.ndarray,
+    run_vals: np.ndarray,
+    queries: np.ndarray,
+    out: np.ndarray,
+    pending: np.ndarray,
+) -> None:
+    """Point-lookup `queries` in one lo-major-sorted run; writes hits into
+    `out` and clears their `pending` bits. Equal-lo ties are scanned
+    forward (runs are tiny — random u64 lo values collide ~never)."""
+    n = len(run_keys)
+    if n == 0 or not pending.any():
+        return
+    run_lo = run_keys["lo"]
+    run_hi = run_keys["hi"]
+    ix = np.searchsorted(run_lo, queries["lo"], side="left")
+    active = pending.copy()
+    off = 0
+    while True:
+        pos = ix + off
+        in_range = active & (pos < n)
+        if not in_range.any():
+            break
+        posc = np.minimum(pos, n - 1)
+        lo_match = in_range & (run_lo[posc] == queries["lo"])
+        if not lo_match.any():
+            break
+        hit = lo_match & (run_hi[posc] == queries["hi"])
+        rows = np.nonzero(hit)[0]
+        out[rows] = run_vals[posc[rows]]
+        pending[rows] = False
+        active = lo_match & ~hit
+        off += 1
+
+
 class U128Index:
-    """Batched u128 → u32 map as sorted runs (keys are unique by contract).
+    """Batched u128 → u32 map as lo-major sorted runs (keys unique by
+    contract).
 
     insert_batch / lookup_batch are the only APIs — single-key operations
-    would serialize the hot path. `memtable_max` plays the role of the
-    reference's mutable-table size; `runs_max` of its level count before a
-    full merge (tree.zig / compaction.zig, radically simplified).
+    would serialize the hot path. Each inserted batch is sorted once at
+    insert time (never re-sorted per lookup); `memtable_max` plays the role
+    of the reference's mutable-table size, `runs_max` of its level count
+    before a full merge (tree.zig / compaction.zig, radically simplified).
     """
 
     def __init__(self, memtable_max: int = 1 << 16, runs_max: int = 6) -> None:
-        self._mem: List[Tuple[np.ndarray, np.ndarray]] = []  # unsorted batches
+        self._mem: List[Tuple[np.ndarray, np.ndarray]] = []  # sorted batches
         self._mem_count = 0
         self._runs: List[Tuple[np.ndarray, np.ndarray]] = []  # sorted (keys, vals)
         self.memtable_max = memtable_max
@@ -49,7 +95,8 @@ class U128Index:
     def insert_batch(self, keys: np.ndarray, values: np.ndarray) -> None:
         if len(keys) == 0:
             return
-        self._mem.append((keys, np.asarray(values, dtype=np.uint32)))
+        order = sort_lo_major(keys)
+        self._mem.append((keys[order], np.asarray(values, dtype=np.uint32)[order]))
         self._mem_count += len(keys)
         self.count += len(keys)
         if self._mem_count >= self.memtable_max:
@@ -60,7 +107,7 @@ class U128Index:
     def _flush_memtable(self) -> None:
         keys = np.concatenate([k for k, _ in self._mem])
         vals = np.concatenate([v for _, v in self._mem])
-        order = np.argsort(keys, kind="stable")
+        order = sort_lo_major(keys)
         self._runs.append((keys[order], vals[order]))
         self._mem = []
         self._mem_count = 0
@@ -68,7 +115,7 @@ class U128Index:
     def _merge_runs(self) -> None:
         keys = np.concatenate([k for k, _ in self._runs])
         vals = np.concatenate([v for _, v in self._runs])
-        order = np.argsort(keys, kind="stable")
+        order = sort_lo_major(keys)
         self._runs = [(keys[order], vals[order])]
 
     def lookup_batch(self, keys: np.ndarray) -> np.ndarray:
@@ -77,19 +124,11 @@ class U128Index:
         out = np.full(n, NOT_FOUND, dtype=np.uint32)
         if n == 0:
             return out
+        pending = np.ones(n, dtype=bool)
         for run_keys, run_vals in self._runs:
-            ix = np.searchsorted(run_keys, keys)
-            ix_c = np.minimum(ix, len(run_keys) - 1)
-            hit = (ix < len(run_keys)) & (run_keys[ix_c] == keys)
-            out[hit] = run_vals[ix_c[hit]]
+            search_run(run_keys, run_vals, keys, out, pending)
         for mem_keys, mem_vals in self._mem:
-            # Memtable batches are small and unsorted; sort queries instead.
-            order = np.argsort(mem_keys, kind="stable")
-            sk, sv = mem_keys[order], mem_vals[order]
-            ix = np.searchsorted(sk, keys)
-            ix_c = np.minimum(ix, len(sk) - 1)
-            hit = (ix < len(sk)) & (sk[ix_c] == keys)
-            out[hit] = sv[ix_c[hit]]
+            search_run(mem_keys, mem_vals, keys, out, pending)
         return out
 
     def contains_any(self, keys: np.ndarray) -> bool:

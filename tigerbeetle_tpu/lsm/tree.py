@@ -31,7 +31,12 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from tigerbeetle_tpu.io.grid import Grid
-from tigerbeetle_tpu.lsm.store import KEY_DTYPE, NOT_FOUND
+from tigerbeetle_tpu.lsm.store import (
+    KEY_DTYPE,
+    NOT_FOUND,
+    search_run,
+    sort_lo_major,
+)
 
 ENTRY_SIZE = KEY_DTYPE.itemsize + 4  # key + u32 value
 
@@ -58,26 +63,6 @@ MANIFEST_DTYPE = np.dtype(
 
 BLOCK_TYPE_DATA = 1
 BLOCK_TYPE_INDEX = 2
-
-
-def _keys_to_limbs(keys: np.ndarray) -> np.ndarray:
-    """KEY_DTYPE (hi, lo) → (n, 4) u32 little-endian limbs for the device."""
-    out = np.empty((len(keys), 4), dtype=np.uint32)
-    lo = keys["lo"]
-    hi = keys["hi"]
-    out[:, 0] = lo & 0xFFFFFFFF
-    out[:, 1] = lo >> np.uint64(32)
-    out[:, 2] = hi & 0xFFFFFFFF
-    out[:, 3] = hi >> np.uint64(32)
-    return out
-
-
-def _limbs_to_keys(limbs: np.ndarray) -> np.ndarray:
-    out = np.empty(len(limbs), dtype=KEY_DTYPE)
-    l64 = limbs.astype(np.uint64)
-    out["lo"] = l64[:, 0] | (l64[:, 1] << np.uint64(32))
-    out["hi"] = l64[:, 2] | (l64[:, 3] << np.uint64(32))
-    return out
 
 
 @dataclass
@@ -137,13 +122,13 @@ class _MergeStream:
             self.keys = np.zeros(0, dtype=KEY_DTYPE)
             self.vals = np.zeros(0, dtype=np.uint32)
             return k, v
-        cut = int(np.searchsorted(self.keys, upto_key, side="right"))
+        cut = int(np.searchsorted(self.keys["lo"], upto_key, side="right"))
         k, v = self.keys[:cut], self.vals[:cut]
         self.keys, self.vals = self.keys[cut:], self.vals[cut:]
         return k, v
 
-    def last_buffered_key(self) -> np.void:
-        return self.keys[-1]
+    def last_buffered_lo(self) -> int:
+        return int(self.keys[-1]["lo"])
 
 
 class DurableIndex:
@@ -191,7 +176,11 @@ class DurableIndex:
     def insert_batch(self, keys: np.ndarray, values: np.ndarray) -> None:
         if len(keys) == 0:
             return
-        self._mem.append((np.asarray(keys), np.asarray(values, dtype=np.uint32)))
+        keys = np.asarray(keys)
+        vals = np.asarray(values, dtype=np.uint32)
+        # Sort each batch once at insert time so lookups never re-sort.
+        order = sort_lo_major(keys)
+        self._mem.append((keys[order], vals[order]))
         self._mem_count += len(keys)
         self.count += len(keys)
         if self._mem_count >= self.memtable_max:
@@ -202,7 +191,7 @@ class DurableIndex:
             return
         keys = np.concatenate([k for k, _ in self._mem])
         vals = np.concatenate([v for _, v in self._mem])
-        order = np.argsort(keys, kind="stable")
+        order = sort_lo_major(keys)
         self._mem = []
         self._mem_count = 0
         table = self._build_table(keys[order], vals[order])
@@ -297,10 +286,7 @@ class DurableIndex:
         from tigerbeetle_tpu.ops import merge as merge_ops
 
         if self.backend == "jax":
-            lk, lv = merge_ops.merge_device(
-                _keys_to_limbs(ka), va, _keys_to_limbs(kb), vb
-            )
-            return _limbs_to_keys(lk), lv
+            return merge_ops.merge_device(ka, va, kb, vb)
         return merge_ops.merge_host(ka, va, kb, vb)
 
     def _merge_tables(
@@ -321,12 +307,11 @@ class DurableIndex:
             if a_empty:
                 out.append(*b.take(None))
                 continue
-            # Emit everything up to the smaller of the two buffered tails —
-            # all later input is strictly greater, so the prefix is final.
-            la, lb = a.last_buffered_key(), b.last_buffered_key()
-            # np.void scalars have no ordering ufunc — compare as tuples.
-            a_le = (int(la["hi"]), int(la["lo"])) <= (int(lb["hi"]), int(lb["lo"]))
-            bound = la if a_le else lb
+            # Emit everything up to the smaller of the two buffered tail
+            # lo-keys — all later input sorts at or past it; a lo-tie run
+            # split across windows is fine (point lookups verify hi, and
+            # the non-unique read path sorts values per key).
+            bound = min(a.last_buffered_lo(), b.last_buffered_lo())
             ka, va = a.take(bound)
             kb, vb = b.take(bound)
             if len(ka) and len(kb):
@@ -337,6 +322,28 @@ class DurableIndex:
             elif len(kb):
                 out.append(kb, vb)
         return out.finish()
+
+    def compact_all(self) -> None:
+        """Forced major compaction: fold every level into one bottom run
+        (the reference's compaction-storm shape, BASELINE config 5 —
+        compaction.zig pacing collapsed into one synchronous pass)."""
+        self.flush_memtable()
+        # Oldest-first: deeper levels hold older data; within a level,
+        # append order is age order. Stability keeps the older run on the
+        # A side of every fold.
+        tables: List[TableInfo] = [
+            t for level in reversed(self.levels) for t in level
+        ]
+        if len(tables) <= 1:
+            return
+        merged = [tables[0]]
+        for t in tables[1:]:
+            new = self._merge_tables(merged, [t])
+            for old in merged:
+                self._release_table(old)
+            self._release_table(t)
+            merged = new
+        self.levels = [[], merged]
 
     # --- read path ------------------------------------------------------
 
@@ -352,15 +359,10 @@ class DurableIndex:
         if n == 0:
             return out
         pending = np.ones(n, dtype=bool)
-        # Memtable first (newest writes win for unique indexes).
+        # Memtable first (newest writes win for unique indexes); batches
+        # are lo-major-sorted at insert time.
         for mem_keys, mem_vals in reversed(self._mem):
-            order = np.argsort(mem_keys, kind="stable")
-            sk, sv = mem_keys[order], mem_vals[order]
-            ix = np.searchsorted(sk, keys)
-            ix_c = np.minimum(ix, len(sk) - 1)
-            hit = pending & (ix < len(sk)) & (sk[ix_c] == keys)
-            out[hit] = sv[ix_c[hit]]
-            pending &= ~hit
+            search_run(mem_keys, mem_vals, keys, out, pending)
         if not pending.any():
             return out
         for table in self._tables_newest_first():
@@ -371,24 +373,38 @@ class DurableIndex:
 
     def _lookup_table(self, table, keys, out, pending) -> None:
         fences = self._table_fences(table)
-        # Candidate data block per key: first block whose last >= key.
-        last = np.zeros(len(fences), dtype=KEY_DTYPE)
-        last["hi"], last["lo"] = fences["last_hi"], fences["last_lo"]
-        cand = np.searchsorted(last, keys, side="left")
-        valid = pending & (cand < len(fences))
-        if not valid.any():
-            return
-        for b in np.unique(cand[valid]):
-            in_b = valid & (cand == b)
-            bk, bv = self._read_data_block(
-                int(fences[b]["block"]), int(fences[b]["count"])
-            )
-            ix = np.searchsorted(bk, keys[in_b])
-            ix_c = np.minimum(ix, len(bk) - 1)
-            hit = (ix < len(bk)) & (bk[ix_c] == keys[in_b])
-            rows = np.nonzero(in_b)[0][hit]
-            out[rows] = bv[ix_c[hit]]
-            pending[rows] = False
+        # Candidate data block per key: first block whose last_lo >= lo.
+        # A lo-tie run can span blocks, so walk forward while unresolved
+        # keys still fall inside a block whose range covers their lo.
+        n_blocks = len(fences)
+        q_lo = keys["lo"]
+        cand = np.searchsorted(fences["last_lo"], q_lo, side="left")
+        active = pending.copy()
+        off = 0
+        while True:
+            blk = cand + off
+            in_range = active & (blk < n_blocks)
+            if not in_range.any():
+                break
+            blkc = np.minimum(blk, n_blocks - 1)
+            covered = in_range & (fences["first_lo"][blkc] <= q_lo)
+            if not covered.any():
+                break
+            for b in np.unique(blkc[covered]):
+                # Compact to this block's queries so search_run's passes
+                # scale with the block's hits, not the whole batch.
+                ix = np.nonzero(covered & (blkc == b))[0]
+                bk, bv = self._read_data_block(
+                    int(fences[b]["block"]), int(fences[b]["count"])
+                )
+                sub_out = out[ix]
+                sub_pending = np.ones(len(ix), dtype=bool)
+                search_run(bk, bv, keys[ix], sub_out, sub_pending)
+                resolved = ix[~sub_pending]
+                out[resolved] = sub_out[~sub_pending]
+                pending[resolved] = False
+                active[resolved] = False
+            off += 1
 
     def contains_any(self, keys: np.ndarray) -> bool:
         return bool(np.any(self.lookup_batch(keys) != NOT_FOUND))
@@ -396,25 +412,25 @@ class DurableIndex:
     def lookup_range(self, key: np.void) -> np.ndarray:
         """All values stored under `key` (non-unique index), ascending."""
         assert not self.unique
+        k_lo = key["lo"]
+        k_hi = key["hi"]
         parts: List[np.ndarray] = []
         for table in self._tables_newest_first():
             fences = self._table_fences(table)
-            last = np.zeros(len(fences), dtype=KEY_DTYPE)
-            last["hi"], last["lo"] = fences["last_hi"], fences["last_lo"]
-            first = np.zeros(len(fences), dtype=KEY_DTYPE)
-            first["hi"], first["lo"] = fences["first_hi"], fences["first_lo"]
-            b_lo = int(np.searchsorted(last, key, side="left"))
-            b_hi = int(np.searchsorted(first, key, side="right"))
+            b_lo = int(np.searchsorted(fences["last_lo"], k_lo, side="left"))
+            b_hi = int(np.searchsorted(fences["first_lo"], k_lo, side="right"))
             for b in range(b_lo, min(b_hi, len(fences))):
                 bk, bv = self._read_data_block(
                     int(fences[b]["block"]), int(fences[b]["count"])
                 )
-                s = np.searchsorted(bk, key, side="left")
-                e = np.searchsorted(bk, key, side="right")
+                s = np.searchsorted(bk["lo"], k_lo, side="left")
+                e = np.searchsorted(bk["lo"], k_lo, side="right")
                 if e > s:
-                    parts.append(bv[s:e])
+                    sel = bk["hi"][s:e] == k_hi
+                    if sel.any():
+                        parts.append(bv[s:e][sel])
         for mem_keys, mem_vals in self._mem:
-            hit = mem_keys == key
+            hit = (mem_keys["lo"] == k_lo) & (mem_keys["hi"] == k_hi)
             if hit.any():
                 parts.append(mem_vals[hit])
         if not parts:

@@ -33,6 +33,8 @@ from tigerbeetle_tpu.lsm.store import (
     NOT_FOUND,
     U128Index,
     pack_keys,
+    search_run,
+    sort_lo_major,
 )
 from tigerbeetle_tpu.models import oracle as oracle_mod
 from tigerbeetle_tpu.models.oracle import Oracle
@@ -390,9 +392,12 @@ class StateMachine:
         hard = False
         sorted_ids = keys
         if n > 1:
-            # KEY_DTYPE field order is (hi, lo): structured sort == u128 order.
-            sorted_ids = np.sort(keys)
-            hard = bool(np.any(sorted_ids[1:] == sorted_ids[:-1]))
+            # lo-major sort with hi tiebreak: equal-lo duplicates must land
+            # adjacent for the duplicate check (a lo-only stable sort would
+            # leave (hi=1,lo=5),(hi=2,lo=5),(hi=1,lo=5) non-adjacent).
+            sorted_ids = keys[np.lexsort((keys["hi"], keys["lo"]))]
+            adj = sorted_ids["lo"][1:] == sorted_ids["lo"][:-1]
+            hard = bool(np.any(adj & (sorted_ids["hi"][1:] == sorted_ids["hi"][:-1])))
         if not hard:
             hard = self.transfer_index.contains_any(keys)
         pv_keys = None
@@ -400,9 +405,12 @@ class StateMachine:
             pv_keys = pack_keys(
                 events["pending_id_lo"][is_pv], events["pending_id_hi"][is_pv]
             )
-            ix = np.searchsorted(sorted_ids, pv_keys)
-            ixc = np.minimum(ix, n - 1)
-            hard = bool(np.any((ix < n) & (sorted_ids[ixc] == pv_keys)))
+            hit = np.full(len(pv_keys), NOT_FOUND, dtype=np.uint32)
+            search_run(
+                sorted_ids, np.zeros(n, dtype=np.uint32), pv_keys,
+                hit, np.ones(len(pv_keys), dtype=bool),
+            )
+            hard = bool(np.any(hit == 0))
         if hard:
             self.stats["serial_batches"] += 1
             return self._create_transfers_serial(events, timestamp)

@@ -11,8 +11,13 @@ re-expression is sort-free and fully data-parallel:
     → two vectorized branchless binary searches (lax-unrolled, the device
       analog of the reference's branchless binary_search.zig) + two
       scatters. O((n+m)·log) lane-parallel work, no data-dependent control
-      flow, exact for multi-limb (u128) keys via lexicographic limb compares
-      (ops/u128.lt — no native u64/u128 on TPU).
+      flow.
+
+Merge order is **lo-major** (the u128 key's low u64 word; ties in lo keep
+A-before-B, matching the host tier's point-lookup discipline — see
+lsm/store.py). The hi word rides as payload, so compares touch 2 limbs,
+not 4; a third pad-flag limb makes padding sort strictly last even when a
+real key's lo is all-ones.
 
 K-way level merges fold pairwise over this kernel, streaming block-sized
 windows through HBM (lsm/tree.py paces the windows). Stability contract:
@@ -58,69 +63,78 @@ def _bound(keys: jnp.ndarray, queries: jnp.ndarray, upper: bool) -> jnp.ndarray:
 
 @functools.partial(jax.jit, static_argnames=())
 def merge_kernel(keys_a, vals_a, keys_b, vals_b):
-    """Stable merge of two padded sorted runs (pads = all-ones sentinel keys,
-    which sort past every legal key). Returns (keys (n+m, W), vals (n+m,))."""
+    """Stable merge of two padded sorted runs (pads must sort past every
+    legal key). vals may be (n,) or (n, K). Returns (keys (n+m, W), vals)."""
     n = keys_a.shape[0]
     m = keys_b.shape[0]
     pos_a = jnp.arange(n, dtype=I32) + _bound(keys_b, keys_a, upper=False)
     pos_b = jnp.arange(m, dtype=I32) + _bound(keys_a, keys_b, upper=True)
     out_keys = jnp.zeros((n + m, keys_a.shape[1]), dtype=keys_a.dtype)
     out_keys = out_keys.at[pos_a].set(keys_a).at[pos_b].set(keys_b)
-    out_vals = jnp.zeros((n + m,), dtype=vals_a.dtype)
+    out_vals = jnp.zeros((n + m, *vals_a.shape[1:]), dtype=vals_a.dtype)
     out_vals = out_vals.at[pos_a].set(vals_a).at[pos_b].set(vals_b)
     return out_keys, out_vals
 
 
-_SENTINEL = 0xFFFFFFFF
-
-
 def _pad_pow2(keys: np.ndarray, vals: np.ndarray):
-    """Pad to the next power-of-two bucket with all-ones sentinel keys so the
-    kernel compiles once per bucket size, not per run length."""
+    """Pad to the next power-of-two bucket so the kernel compiles once per
+    bucket size. Pad rows set the pad-flag limb (last key column) to 1,
+    which sorts strictly after every real key."""
     n = len(keys)
     n_pad = 1 << max(4, (max(n, 1) - 1).bit_length())
     if n == n_pad:
         return keys, vals
-    pk = np.full((n_pad, keys.shape[1]), _SENTINEL, dtype=keys.dtype)
+    pk = np.zeros((n_pad, keys.shape[1]), dtype=keys.dtype)
     pk[:n] = keys
-    pv = np.zeros((n_pad,), dtype=vals.dtype)
+    pk[n:, -1] = 1
+    pv = np.zeros((n_pad, *vals.shape[1:]), dtype=vals.dtype)
     pv[:n] = vals
     return pk, pv
 
 
 def merge_device(keys_a, vals_a, keys_b, vals_b):
-    """Host wrapper: pad → device merge → slice. Keys are (n, W) u32 limb
-    arrays; all real keys must be < the all-ones sentinel (ids and
-    timestamps are validated != INT_MAX upstream)."""
+    """Merge two lo-major-sorted structured KEY_DTYPE runs on device.
+
+    Comparison key: (lo as 2 u32 limbs, pad flag). hi + value ride as a
+    (n, 3) u32 payload.
+    """
+    from tigerbeetle_tpu.lsm.store import KEY_DTYPE
+
+    def to_dev(keys, vals):
+        n = len(keys)
+        k = np.zeros((n, 3), dtype=np.uint32)
+        k[:, 0] = keys["lo"] & 0xFFFFFFFF
+        k[:, 1] = keys["lo"] >> np.uint64(32)
+        p = np.zeros((n, 3), dtype=np.uint32)
+        p[:, 0] = keys["hi"] & 0xFFFFFFFF
+        p[:, 1] = keys["hi"] >> np.uint64(32)
+        p[:, 2] = vals
+        return _pad_pow2(k, p)
+
     n, m = len(keys_a), len(keys_b)
-    ka, va = _pad_pow2(np.asarray(keys_a), np.asarray(vals_a))
-    kb, vb = _pad_pow2(np.asarray(keys_b), np.asarray(vals_b))
-    ok, ov = merge_kernel(ka, va, kb, vb)
-    return np.asarray(ok)[: n + m], np.asarray(ov)[: n + m]
+    ka, pa = to_dev(keys_a, vals_a)
+    kb, pb = to_dev(keys_b, vals_b)
+    ok, op = merge_kernel(ka, pa, kb, pb)
+    ok = np.asarray(ok)[: n + m]
+    op = np.asarray(op)[: n + m]
+    out = np.empty(n + m, dtype=KEY_DTYPE)
+    out["lo"] = ok[:, 0].astype(np.uint64) | (ok[:, 1].astype(np.uint64) << 32)
+    out["hi"] = op[:, 0].astype(np.uint64) | (op[:, 1].astype(np.uint64) << 32)
+    return out, op[:, 2].copy()
 
 
 def merge_host(keys_a, vals_a, keys_b, vals_b):
     """Numpy reference with identical semantics (byte-equality oracle and
-    the CPU-backend fallback). Keys as structured (hi, lo) or limb arrays —
-    anything np.searchsorted can order; limb arrays are compared via a
-    packed structured view."""
-    ka, kb = np.asarray(keys_a), np.asarray(keys_b)
-    if ka.dtype.fields is None:
-        # (n, W) u32 limbs → structured (w3, w2, w1, w0) for lexicographic
-        # compare, most significant limb first.
-        w = ka.shape[1]
-        dt = np.dtype([(f"w{i}", "<u4") for i in range(w)])
-        pa = np.ascontiguousarray(ka[:, ::-1]).view(dt).reshape(-1)
-        pb = np.ascontiguousarray(kb[:, ::-1]).view(dt).reshape(-1)
-    else:
-        pa, pb = ka, kb
+    the CPU-backend fallback): stable lo-major merge of structured runs."""
+    pa = np.asarray(keys_a)["lo"]
+    pb = np.asarray(keys_b)["lo"]
     n, m = len(pa), len(pb)
     pos_a = np.arange(n) + np.searchsorted(pb, pa, side="left")
     pos_b = np.arange(m) + np.searchsorted(pa, pb, side="right")
-    out_keys = np.zeros((n + m, *ka.shape[1:]), dtype=ka.dtype)
+    out_keys = np.zeros((n + m,), dtype=np.asarray(keys_a).dtype)
     out_vals = np.zeros((n + m,), dtype=np.asarray(vals_a).dtype)
-    out_keys[pos_a] = ka
-    out_keys[pos_b] = kb
+    out_keys[pos_a] = keys_a
+    out_keys[pos_b] = keys_b
     out_vals[pos_a] = vals_a
     out_vals[pos_b] = vals_b
     return out_keys, out_vals
