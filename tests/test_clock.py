@@ -1,0 +1,196 @@
+"""Cluster clock: Marzullo interval agreement + ping/pong offset sampling.
+
+Mirrors the reference's test strategy for vsr/clock.zig + marzullo.zig:
+algorithm unit tests on hand-built interval sets, then whole-cluster
+scenarios with injected deterministic (skewed) clocks, asserting that the
+primary's prepare timestamps stay inside cluster-agreed bounds and that the
+simulation stays byte-reproducible.
+"""
+
+import numpy as np
+
+from tigerbeetle_tpu.vsr.clock import (
+    NS_PER_MS,
+    Clock,
+    DeterministicTime,
+    TOLERANCE_NS,
+    WINDOW_NS,
+)
+from tigerbeetle_tpu.vsr.marzullo import Interval, smallest_interval
+
+
+class TestMarzullo:
+    def test_empty(self):
+        assert smallest_interval([]) == Interval(0, 0, 0)
+
+    def test_single(self):
+        assert smallest_interval([(5, 10)]) == Interval(5, 10, 1)
+
+    def test_classic_three_sources(self):
+        # Wikipedia's canonical example: [8,12], [11,13], [10,12] → [11,12]x3.
+        got = smallest_interval([(8, 12), (11, 13), (10, 12)])
+        assert got == Interval(11, 12, 3)
+
+    def test_outlier_excluded(self):
+        # Two agreeing sources + one wild outlier: best=2, outlier ignored.
+        got = smallest_interval([(0, 4), (2, 6), (100, 104)])
+        assert got == Interval(2, 4, 2)
+
+    def test_disjoint_ties_pick_first(self):
+        got = smallest_interval([(0, 1), (10, 11)])
+        assert got.sources_true == 1
+        assert (got.lower_bound, got.upper_bound) == (0, 1)
+
+    def test_touching_intervals_overlap(self):
+        # A start meeting an end at the same offset counts as overlap
+        # (starts sort before ends).
+        got = smallest_interval([(0, 5), (5, 10)])
+        assert got == Interval(5, 5, 2)
+
+    def test_negative_offsets(self):
+        got = smallest_interval([(-10, -2), (-5, 3), (-6, -1)])
+        assert got.sources_true == 3
+        assert got.lower_bound == -5
+        assert got.upper_bound == -2
+
+
+def _exchange(clock: Clock, peer_time: DeterministicTime, peer: int, rtt_ticks: int = 1):
+    """Simulate one ping/pong round trip against a peer clock."""
+    m0 = clock.ping_timestamp()
+    # Half RTT out, peer answers, half RTT back.
+    for _ in range(rtt_ticks):
+        clock.time.tick()
+        peer_time.tick()
+    t_remote = peer_time.realtime_ns()
+    for _ in range(rtt_ticks):
+        clock.time.tick()
+        peer_time.tick()
+    clock.learn(peer, m0=m0, t_remote=t_remote, m1=clock.time.monotonic_ns())
+
+
+class TestClock:
+    def test_solo_cluster_synchronizes_to_self(self):
+        t = DeterministicTime()
+        c = Clock(t, replica_count=1, replica_index=0)
+        for _ in range(WINDOW_NS // t.tick_ns + 1):
+            t.tick()
+            c.tick()
+        assert c.synchronized == Interval(0, 0, 1)
+        assert c.realtime_synchronized() == t.realtime_ns()
+
+    def test_offset_recovered_within_bounds(self):
+        # Peers' wall clocks run +300ms and +320ms ahead (their sample
+        # intervals overlap; ours doesn't): the agreed interval must cover
+        # the overlap and realtime_synchronized() must pull us forward.
+        t0 = DeterministicTime(offset_ns=0)
+        t1 = DeterministicTime(offset_ns=300 * NS_PER_MS)
+        t2 = DeterministicTime(offset_ns=320 * NS_PER_MS)
+        c = Clock(t0, replica_count=3, replica_index=0)
+        _exchange(c, t1, peer=1)
+        _exchange(c, t2, peer=2)
+        for _ in range(WINDOW_NS // t0.tick_ns + 1):
+            t0.tick()
+            t1.tick()
+            t2.tick()
+            c.tick()
+        assert c.synchronized is not None
+        # Quorum is 2 of 3: self's (0,0) can only pair with one peer; the
+        # two peers' intervals (300±err, 500±err) don't overlap self.
+        assert c.synchronized.sources_true >= 2
+        rt = c.realtime_synchronized()
+        # Pulled forward, but never beyond the agreed upper bound.
+        assert rt >= t0.realtime_ns()
+        assert rt <= t0.realtime_ns() + 500 * NS_PER_MS + TOLERANCE_NS + 2 * t0.tick_ns
+
+    def test_quorum_not_reached_keeps_epoch_none(self):
+        # 3 replicas, but only one wildly-different peer sample: self (0,0)
+        # and peer (10s) never overlap → no quorum of 2... except self+peer
+        # intervals are disjoint, so best count is 1 < quorum.
+        t0 = DeterministicTime()
+        t1 = DeterministicTime(offset_ns=10_000 * NS_PER_MS)
+        c = Clock(t0, replica_count=3, replica_index=0)
+        _exchange(c, t1, peer=1)
+        for _ in range(WINDOW_NS // t0.tick_ns + 1):
+            t0.tick()
+            t1.tick()
+            c.tick()
+        assert c.synchronized is None
+        assert c.realtime_synchronized() is None
+
+    def test_post_epoch_wall_step_is_bounded(self):
+        # After synchronization, a wall-clock step must not leak into
+        # realtime_synchronized(): the epoch anchors + monotonic elapsed
+        # bound it (clock.zig:254-266).
+        t = DeterministicTime()
+        c = Clock(t, replica_count=1, replica_index=0)
+        for _ in range(WINDOW_NS // t.tick_ns + 1):
+            t.tick()
+            c.tick()
+        assert c.synchronized is not None
+        before = c.realtime_synchronized()
+        t.offset_ns += 3_600_000 * NS_PER_MS  # operator steps wall +1h
+        t.tick()
+        after = c.realtime_synchronized()
+        assert after - before <= 2 * t.tick_ns  # bounded by elapsed, not the step
+
+    def test_stale_epoch_expires(self):
+        from tigerbeetle_tpu.vsr.clock import EPOCH_MAX_NS
+
+        t0 = DeterministicTime()
+        t1 = DeterministicTime(offset_ns=5 * NS_PER_MS)
+        c = Clock(t0, replica_count=2, replica_index=0)
+        _exchange(c, t1, peer=1)
+        for _ in range(WINDOW_NS // t0.tick_ns + 1):
+            t0.tick()
+            c.tick()
+        assert c.synchronized is not None
+        # No further samples: after EPOCH_MAX_NS the epoch must lapse.
+        for _ in range(EPOCH_MAX_NS // t0.tick_ns + 2):
+            t0.tick()
+            c.tick()
+        assert c.synchronized is None
+        assert c.realtime_synchronized() is None
+
+    def test_lowest_rtt_sample_wins(self):
+        t0 = DeterministicTime()
+        t1 = DeterministicTime(offset_ns=100 * NS_PER_MS)
+        c = Clock(t0, replica_count=2, replica_index=0)
+        _exchange(c, t1, peer=1, rtt_ticks=10)  # sloppy sample first
+        wide = c.samples[1]
+        _exchange(c, t1, peer=1, rtt_ticks=1)  # tight sample replaces it
+        tight = c.samples[1]
+        assert tight.rtt_ns < wide.rtt_ns
+        assert (tight.offset_hi - tight.offset_lo) < (wide.offset_hi - wide.offset_lo)
+
+
+class TestClusterClock:
+    def _run_cluster(self, ticks=700):
+        from tigerbeetle_tpu.testing.cluster import Cluster
+
+        cluster = Cluster(replica_count=3, seed=99)
+        cluster.run(ticks)
+        return cluster
+
+    def test_replicas_synchronize_and_stamp_sanely(self):
+        cluster = self._run_cluster()
+        primary = next(r for r in cluster.replicas if r.is_primary)
+        # With identical deterministic clocks the agreed offset straddles 0.
+        assert primary.clock.synchronized is not None
+        assert primary.clock.synchronized.lower_bound <= 0
+        assert primary.clock.synchronized.upper_bound >= 0
+        # Prepare timestamps track the deterministic wall clock.
+        assert primary._realtime_ns() == primary.time.realtime_ns()
+
+    def test_cluster_determinism_with_clock(self):
+        from tigerbeetle_tpu.testing.cluster import Cluster
+
+        def run():
+            c = Cluster(replica_count=3, seed=123)
+            c.run(500)
+            return [
+                (r.tick_count, r.clock.epochs,
+                 r.clock.synchronized.lower_bound if r.clock.synchronized else None)
+                for r in c.replicas
+            ]
+
+        assert run() == run()

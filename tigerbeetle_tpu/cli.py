@@ -97,6 +97,8 @@ def cmd_start(args) -> int:
         grid_block_count=config.grid_block_count,
         grid_block_size=config.lsm_block_size,
     )
+    from tigerbeetle_tpu.vsr.clock import SystemTime
+
     addresses = parse_addresses(args.addresses)
     storage = FileStorage(args.path)
     replica = Replica(
@@ -109,14 +111,22 @@ def cmd_start(args) -> int:
         bus=None,  # injected by ReplicaServer
         snapshot_store=FileSnapshotStore(args.path),
         sm_backend=args.backend,
+        time=SystemTime(),
     )
     server = ReplicaServer(replica, addresses)
     replica.open()
     host, port = addresses[args.replica]
-    print(f"replica {args.replica}/{len(addresses)} listening on {host}:{port} "
-          f"(backend={args.backend}, status={replica.status})", flush=True)
+
+    async def _serve() -> None:
+        # Bind BEFORE announcing: tooling (benchmark driver, scripts) waits
+        # for this line and connects immediately.
+        await server.start()
+        print(f"replica {args.replica}/{len(addresses)} listening on {host}:{port} "
+              f"(backend={args.backend}, status={replica.status})", flush=True)
+        await server.serve_forever()
+
     try:
-        asyncio.run(server.serve_forever())
+        asyncio.run(_serve())
     except KeyboardInterrupt:
         pass
     return 0

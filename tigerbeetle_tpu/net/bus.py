@@ -110,7 +110,8 @@ class ReplicaServer:
         asyncio.ensure_future(self._tick_loop())
 
     async def serve_forever(self) -> None:
-        await self.start()
+        if self._server is None:
+            await self.start()
         await self._stopping.wait()
 
     def stop(self) -> None:

@@ -33,6 +33,7 @@ from tigerbeetle_tpu.io.storage import Zone
 from tigerbeetle_tpu.models.state_machine import StateMachine
 from tigerbeetle_tpu.vsr import header as hdr
 from tigerbeetle_tpu.vsr import snapshot
+from tigerbeetle_tpu.vsr.clock import Clock, DeterministicTime
 from tigerbeetle_tpu.vsr.header import Command, Header, Message, Operation
 from tigerbeetle_tpu.vsr.journal import Journal
 from tigerbeetle_tpu.vsr.superblock import SuperBlock, VSRState
@@ -101,6 +102,7 @@ class Replica:
         snapshot_store=None,
         sm_backend: str = "numpy",
         on_event: Optional[Callable[[str, "Replica"], None]] = None,
+        time=None,
     ) -> None:
         self.cluster = cluster
         self.replica = replica_index
@@ -161,6 +163,11 @@ class Replica:
         # (checkpoint_op, blob, checksum) cache.
         self._sync: Optional[dict] = None
         self._sync_serve_cache: Optional[tuple] = None
+
+        # Injected time + cluster clock (reference clock.zig via ping/pong
+        # offset samples; DeterministicTime keeps simulations reproducible).
+        self.time = time if time is not None else DeterministicTime()
+        self.clock = Clock(self.time, replica_count, replica_index)
 
         self.tick_count = 0
         self.last_heartbeat_tick = 0
@@ -272,6 +279,11 @@ class Replica:
 
     def tick(self) -> None:
         self.tick_count += 1
+        if hasattr(self.time, "tick"):
+            self.time.tick()  # replica-owned deterministic time
+        self.clock.tick()
+        if self.replica_count > 1 and self.tick_count % PING_TIMEOUT == 0:
+            self._send_clock_pings()
         self._sync_tick()
         if self.status == STATUS_NORMAL:
             if self.is_primary:
@@ -293,14 +305,7 @@ class Replica:
 
     def _recovering_tick(self) -> None:
         if self.tick_count % self.RECOVERING_PING_INTERVAL == 0:
-            ping = hdr.make(
-                Command.PING, self.cluster,
-                view=self.view, replica=self.replica,
-            )
-            m = Message(ping).seal()
-            for r in range(self.replica_count):
-                if r != self.replica:
-                    self.bus.send_to_replica(r, m)
+            self._send_clock_pings()
         normal_views = [v for v, ok in self._recovery_pongs.values() if ok]
         if normal_views:
             # An active view exists — adopt it via request_start_view.
@@ -348,17 +353,37 @@ class Replica:
 
     # --- normal protocol ------------------------------------------------
 
+    def _send_clock_pings(self) -> None:
+        """Periodic clock-offset sampling (reference ping_timeout,
+        replica.zig:2535): ping.op carries our monotonic send stamp."""
+        ping = hdr.make(
+            Command.PING, self.cluster, replica=self.replica, view=self.view,
+            op=self.clock.ping_timestamp(),
+        )
+        m = Message(ping).seal()
+        for r in range(self.replica_count):
+            if r != self.replica:
+                self.bus.send_to_replica(r, m)
+
     def on_ping(self, msg: Message) -> None:
+        # pong echoes the ping's monotonic stamp (op) and carries our wall
+        # time (timestamp) — the clock's offset sample (clock.zig learn).
         pong = hdr.make(
             Command.PONG, self.cluster, replica=self.replica, view=self.view,
             request=1 if self.status == STATUS_NORMAL else 0,
+            op=msg.header["op"],
+            timestamp=self.time.realtime_ns(),
         )
         self.bus.send_to_replica(msg.header["replica"], Message(pong).seal())
 
     def on_pong(self, msg: Message) -> None:
+        h = msg.header
+        self.clock.learn(
+            int(h["replica"]), m0=int(h["op"]), t_remote=int(h["timestamp"]),
+            m1=self.time.monotonic_ns(),
+        )
         if self.status != STATUS_RECOVERING:
             return
-        h = msg.header
         self._recovery_pongs[h["replica"]] = (h["view"], h["request"] == 1)
 
     def on_request(self, msg: Message) -> None:
@@ -1279,9 +1304,13 @@ class Replica:
     # --- execution ------------------------------------------------------
 
     def _realtime_ns(self) -> int:
-        # Deterministic logical clock: ticks as nanoseconds. A Marzullo
-        # cluster clock (reference vsr/clock.zig) is a later round.
-        return self.tick_count
+        """Cluster-synchronized wall time for prepare timestamps
+        (reference replica.zig:1323 realtime_synchronized): the Marzullo
+        epoch bounds the local clock; before the first synchronization the
+        raw injected clock serves (a solo cluster synchronizes to itself
+        on the first window)."""
+        rt = self.clock.realtime_synchronized()
+        return rt if rt is not None else self.time.realtime_ns()
 
     def _execute(self, prepare: Message, replay: bool = False) -> Optional[Message]:
         h = prepare.header
