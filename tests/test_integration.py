@@ -38,7 +38,7 @@ class ServerThread:
 
         config = TEST_MIN
         zone = Zone.for_config(
-            config.journal_slot_count, config.message_size_max, config.clients_max
+            config.journal_slot_count, config.message_size_max
         )
         if fresh:
             st = FileStorage(path, size=zone.total_size, create=True)
@@ -163,7 +163,7 @@ class MultiServerThread:
 
         config = TEST_MIN
         zone = Zone.for_config(
-            config.journal_slot_count, config.message_size_max, config.clients_max
+            config.journal_slot_count, config.message_size_max
         )
         addresses = [("127.0.0.1", p) for p in ports]
         self.servers = []

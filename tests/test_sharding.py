@@ -77,6 +77,65 @@ def test_sharded_matches_single(mesh):
         )
 
 
+def test_sharded_exact_matches_single(mesh):
+    """The exact sweep kernel (balancing/limits/chains/post-void) over
+    sharded state must be byte-identical to single-chip (r3 task 7)."""
+    from tigerbeetle_tpu.ops import commit_exact
+
+    rng = np.random.default_rng(77)
+    state_1, state_n, b, host_code = _setup(mesh, rng)
+    # Rewrite the batch into an exact-kernel shape: balancing flags, a
+    # linked chain, and limit accounts.
+    flags = np.zeros(N, dtype=np.uint32)
+    bal = rng.random(N) < 0.4
+    flags[bal] = np.where(
+        rng.random(int(bal.sum())) < 0.5,
+        np.uint32(commit_ops.F_BAL_DR), np.uint32(commit_ops.F_BAL_CR),
+    )
+    flags[10] = np.uint32(commit_ops.F_LINKED)
+    chain_id = np.arange(N, dtype=np.int32)
+    chain_id[11] = 10
+    b = b._replace(flags=flags)
+    host_code = np.zeros(N, dtype=np.uint32)
+
+    # Seed balances so clamps have room (same on both states).
+    slots = np.arange(100, dtype=np.int32)
+    seed_bal = np.zeros((100, 4), dtype=np.uint32)
+    seed_bal[:, 0] = 1_000_000
+    state_1 = commit_ops.write_balances(
+        state_1, slots, seed_bal, seed_bal, seed_bal, seed_bal
+    )
+    from tigerbeetle_tpu.parallel.sharding import _place
+    dense = commit_ops.LedgerState(*[np.asarray(x) for x in state_1])
+    state_n = _place(dense, mesh)
+
+    pinfo = commit_exact.PendingInfo(
+        found=np.zeros(N, dtype=bool),
+        amount=np.zeros((N, 4), dtype=np.uint32),
+        dr_slot=np.full(N, -1, dtype=np.int32),
+        cr_slot=np.full(N, -1, dtype=np.int32),
+        timestamp=np.zeros((N, 2), dtype=np.uint32),
+        timeout=np.zeros(N, dtype=np.uint32),
+        base_fulfillment=np.full(N, commit_exact.FULFILL_NONE, dtype=np.int32),
+        group=np.full(N, N, dtype=np.int32),
+    )
+
+    new_1, codes_1, amounts_1, _, _, bail_1 = commit_exact.create_transfers_exact(
+        state_1, b, host_code, pinfo, chain_id
+    )
+    step = sharding.make_sharded_commit_exact(mesh, A)
+    new_n, codes_n, amounts_n, _, _, bail_n = step(state_n, b, host_code, pinfo, chain_id)
+
+    assert not bool(bail_1) and not bool(bail_n)
+    np.testing.assert_array_equal(np.asarray(codes_1), np.asarray(codes_n))
+    np.testing.assert_array_equal(np.asarray(amounts_1), np.asarray(amounts_n))
+    assert int((np.asarray(codes_1) == 0).sum()) > 0
+    for f in ("debits_pending", "debits_posted", "credits_pending", "credits_posted"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(new_1, f)), np.asarray(getattr(new_n, f)), err_msg=f
+        )
+
+
 def test_sharded_state_placement(mesh):
     state = sharding.init_sharded_state(A, mesh)
     shard_axis = {d for d in state.debits_posted.sharding.spec}

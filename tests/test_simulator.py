@@ -1,5 +1,7 @@
 """VOPR smoke: a handful of seeds must pass (randomized cluster + faults +
-auditor). The wider sweep runs out-of-band (python -m tigerbeetle_tpu.simulator)."""
+torn-write crashes + auditor). Seed 7 (and every 8th) runs production-sized
+8190-event batches through the full VSR path. The wider sweep runs
+out-of-band (python -m tigerbeetle_tpu.simulator --sweep 200)."""
 
 import pytest
 
@@ -9,3 +11,11 @@ from tigerbeetle_tpu.simulator import EXIT_PASS, Simulator
 @pytest.mark.parametrize("seed", [1, 5, 7, 12, 14, 24])
 def test_vopr_seed(seed):
     assert Simulator(seed, requests=25).run() == EXIT_PASS
+
+
+def test_vopr_big_batch_schedule():
+    sim = Simulator(15, requests=8)  # 15 % 8 == 7 → big-batch mode
+    assert sim.big_batches
+    assert sim.run() == EXIT_PASS
+    # At least one full-size batch actually crossed the VSR path.
+    assert sim.workload.largest_batch == 8190

@@ -48,7 +48,7 @@ def _prepare(cluster_id, *, view, op, timestamp, body, parent=0, replica=0):
 class TestJournalGuards:
     def _journal(self):
         zone = Zone.for_config(
-            TEST_MIN.journal_slot_count, TEST_MIN.message_size_max, TEST_MIN.clients_max
+            TEST_MIN.journal_slot_count, TEST_MIN.message_size_max
         )
         storage = MemStorage(zone.total_size, seed=1)
         return Journal(storage, zone, TEST_MIN.journal_slot_count, TEST_MIN.message_size_max), zone
@@ -98,7 +98,7 @@ class TestDurableRepairTargets:
         restart as a faulty (repair-needed) slot — never serving the stale
         body it overlays (ADVICE r2: repair_target was in-memory only)."""
         zone = Zone.for_config(
-            TEST_MIN.journal_slot_count, TEST_MIN.message_size_max, TEST_MIN.clients_max
+            TEST_MIN.journal_slot_count, TEST_MIN.message_size_max
         )
         storage = MemStorage(zone.total_size, seed=2)
         j = Journal(storage, zone, TEST_MIN.journal_slot_count, TEST_MIN.message_size_max)

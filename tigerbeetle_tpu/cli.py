@@ -34,7 +34,7 @@ def cmd_format(args) -> int:
 
     config = config_by_name(args.config)
     zone = Zone.for_config(
-        config.journal_slot_count, config.message_size_max, config.clients_max,
+        config.journal_slot_count, config.message_size_max,
         grid_block_count=config.grid_block_count,
         grid_block_size=config.lsm_block_size,
     )
@@ -93,7 +93,7 @@ def cmd_start(args) -> int:
 
     config = config_by_name(args.config)
     zone = Zone.for_config(
-        config.journal_slot_count, config.message_size_max, config.clients_max,
+        config.journal_slot_count, config.message_size_max,
         grid_block_count=config.grid_block_count,
         grid_block_size=config.lsm_block_size,
     )

@@ -50,28 +50,33 @@ class Zone:
     def for_config(
         journal_slot_count: int,
         message_size_max: int,
-        clients_max: int,
         superblock_copies: int = 4,
         superblock_copy_size: int = SECTOR_SIZE,
         grid_block_count: int = 0,
         grid_block_size: int = 0,
     ) -> "Zone":
+        # No client_replies zone (reference client_replies.zig:501 reserves
+        # clients_max 1 MiB slots): in this build replies are durable
+        # WITHOUT dedicated storage — the deterministic state machine
+        # rebuilds every session's last reply during WAL replay, and
+        # checkpoints persist the client table including sealed replies
+        # (vsr/snapshot.py clients section). tests/test_cluster.py
+        # test_reply_durable_across_crash proves the at-most-once resend
+        # contract across a dirty restart.
         sb_size = superblock_copies * superblock_copy_size
         wh_size = journal_slot_count * HEADER_SIZE
         wh_size = -(-wh_size // SECTOR_SIZE) * SECTOR_SIZE
         wp_size = journal_slot_count * message_size_max
-        cr_size = clients_max * message_size_max
         sb_off = 0
         wh_off = sb_off + sb_size
         wp_off = wh_off + wh_size
         cr_off = wp_off + wp_size
-        gr_off = cr_off + cr_size
-        gr_off = -(-gr_off // SECTOR_SIZE) * SECTOR_SIZE
+        gr_off = -(-cr_off // SECTOR_SIZE) * SECTOR_SIZE
         return Zone(
             superblock_offset=sb_off, superblock_size=sb_size,
             wal_headers_offset=wh_off, wal_headers_size=wh_size,
             wal_prepares_offset=wp_off, wal_prepares_size=wp_size,
-            client_replies_offset=cr_off, client_replies_size=cr_size,
+            client_replies_offset=cr_off, client_replies_size=0,
             grid_offset=gr_off, grid_size=grid_block_count * grid_block_size,
             grid_block_size=grid_block_size,
         )

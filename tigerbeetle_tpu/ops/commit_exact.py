@@ -274,6 +274,9 @@ def create_transfers_exact_impl(
     pending: PendingInfo,
     chain_id: jnp.ndarray,
     max_sweeps: int = MAX_SWEEPS,
+    *,
+    balance_read=None,
+    balance_apply=None,
 ):
     """Fixed-point commit for order-dependent batches.
 
@@ -281,6 +284,15 @@ def create_transfers_exact_impl(
     singleton chains for unlinked events). The chain-open failure of an
     unterminated trailing chain arrives via host_code (the oracle assigns
     LINKED_EVENT_CHAIN_OPEN before any ladder rung).
+
+    balance_read / balance_apply: optional hooks replacing the direct
+    state-balance gather/scatter so the sweep composes with slot-sharded
+    state under shard_map (parallel/sharding.py): the sweep math itself is
+    batch-global and runs replicated; only the (2n)-row base gather and
+    the final posting touch the sharded tables.
+      balance_read(state, rec_slot (2n,)) -> 4x (2n, 4) u32 pre-balances
+      balance_apply(state, eff_dr, eff_cr, amounts, p_amount,
+                    add_pend, add_post, sub_pend) -> (new_state, overflow)
 
     Returns (new_state, codes (n,), amounts (n,4) — post-clamp/resolved,
     dr_after, cr_after (Observed — post-event balances for history rows),
@@ -352,9 +364,12 @@ def create_transfers_exact_impl(
     sub_head_pos = jax.lax.cummax(
         jnp.where(sub_head, jnp.arange(2 * n, dtype=I32), 0)
     )
-    base = Observed(*[
-        getattr(state, f)[jnp.clip(rec_slot, 0, a_max)] for f in BAL_FIELDS
-    ])
+    if balance_read is None:
+        base = Observed(*[
+            getattr(state, f)[jnp.clip(rec_slot, 0, a_max)] for f in BAL_FIELDS
+        ])
+    else:
+        base = Observed(*balance_read(state, rec_slot))
 
     # --- fulfillment groups: sort post/void records by (group, idx) -----
     f_group = jnp.where(is_pv, pending.group, jnp.int32(n)).astype(I32)
@@ -597,7 +612,10 @@ def create_transfers_exact_impl(
     ok = codes == 0
     amounts = masked(ok, amounts)
 
-    new_state, overflow = _apply(state, b, pending, is_pv, is_post, pend, ok, amounts)
+    new_state, overflow = _apply(
+        state, b, pending, is_pv, is_post, pend, ok, amounts,
+        balance_apply=balance_apply,
+    )
 
     # Post-event balances (observed + own delta) for history rows
     # (state_machine.zig:1342-1364 — regular events only; post/void writes
@@ -623,7 +641,7 @@ def create_transfers_exact_impl(
     return new_state, codes, amounts, dr_after, cr_after, bail
 
 
-def _apply(state, b, pending, is_pv, is_post, pend, ok, amounts):
+def _apply(state, b, pending, is_pv, is_post, pend, ok, amounts, balance_apply=None):
     """Post the final outcomes: adds via exact scatter-add, pending
     removals via exact scatter-sub (post/void)."""
     eff_dr = jnp.where(is_pv, pending.dr_slot, b.dr_slot).astype(I32)
@@ -632,6 +650,12 @@ def _apply(state, b, pending, is_pv, is_post, pend, ok, amounts):
     add_pend = ok & pend & ~is_pv
     add_post = ok & ((~pend & ~is_pv) | (is_pv & is_post))
     sub_pend = ok & is_pv
+
+    if balance_apply is not None:
+        return balance_apply(
+            state, eff_dr, eff_cr, amounts, pending.amount,
+            add_pend, add_post, sub_pend,
+        )
 
     new_dp, o1 = u128.scatter_add(state.debits_pending, eff_dr, amounts, add_pend)
     new_cp, o2 = u128.scatter_add(state.credits_pending, eff_cr, amounts, add_pend)

@@ -158,6 +158,111 @@ def make_sharded_commit(mesh: Mesh, accounts_max: int):
     return jax.jit(sm)
 
 
+def make_sharded_commit_exact(mesh: Mesh, accounts_max: int):
+    """Sharded variant of the exact fixed-point sweep kernel
+    (ops/commit_exact.create_transfers_exact): balancing clamps, limit
+    flags, linked chains, pending post/void over slot-sharded state.
+
+    The sweep itself is batch-global dependency resolution — its
+    parallelism is across the 16k posting lanes, which saturate one chip —
+    so it runs REPLICATED on every device; the mesh contributes state
+    capacity. Only two touch-points meet the sharded balance tables:
+
+      - base gather: each shard contributes its owned rows' pre-batch
+        balances, combined with one psum over 'shard' (one collective of
+        4x(2n,4) u32 before the sweep loop);
+      - posting: each shard applies the debit/credit sides whose slots it
+        owns (masked exact scatter-add/sub), with the overflow flag psum'd
+        so bail is identical everywhere.
+
+    Byte-exactness vs the single-chip kernel: the replicated sweep math is
+    bitwise-identical (same inputs after the base psum reconstructs the
+    same balances), and posting decomposes by slot ownership exactly as in
+    make_sharded_commit.
+    """
+    from tigerbeetle_tpu.ops import commit_exact
+    from tigerbeetle_tpu.ops import u128
+    from tigerbeetle_tpu.ops.commit_exact import BAL_FIELDS, Observed
+
+    n_shard = mesh.shape["shard"]
+    assert accounts_max % n_shard == 0
+
+    def step(state, b, host_code, pending, chain_id):
+        rows = state.debits_pending.shape[0]
+        assert rows == accounts_max // n_shard
+        shard_ix = jax.lax.axis_index("shard").astype(jnp.int32)
+        base_off = shard_ix * rows
+
+        def balance_read(st, rec_slot):
+            # Match the single-chip gather bit-for-bit: invalid slots clip
+            # to row 0 (commit_exact base gather), whose owning shard
+            # contributes its balances — so even failed rows' dr_after/
+            # cr_after outputs stay byte-identical to single-chip.
+            glob = jnp.clip(rec_slot, 0, accounts_max - 1)
+            local = glob - base_off
+            mine = (local >= 0) & (local < rows)
+            lclip = jnp.clip(local, 0, rows - 1)
+            out = []
+            for f in BAL_FIELDS:
+                v = jnp.where(mine[:, None], getattr(st, f)[lclip], jnp.uint32(0))
+                out.append(jax.lax.psum(v, "shard"))
+            return out
+
+        def balance_apply(
+            st, eff_dr, eff_cr, amounts, p_amount, add_pend, add_post, sub_pend
+        ):
+            dr_local = eff_dr - base_off
+            cr_local = eff_cr - base_off
+            dr_mine = (eff_dr >= 0) & (dr_local >= 0) & (dr_local < rows)
+            cr_mine = (eff_cr >= 0) & (cr_local >= 0) & (cr_local < rows)
+            dr_ix = jnp.where(dr_mine, dr_local, jnp.int32(-1))
+            cr_ix = jnp.where(cr_mine, cr_local, jnp.int32(-1))
+
+            new_dp, o1 = u128.scatter_add(
+                st.debits_pending, dr_ix, amounts, add_pend & dr_mine
+            )
+            new_cp, o2 = u128.scatter_add(
+                st.credits_pending, cr_ix, amounts, add_pend & cr_mine
+            )
+            new_dpo, o3 = u128.scatter_add(
+                st.debits_posted, dr_ix, amounts, add_post & dr_mine
+            )
+            new_cpo, o4 = u128.scatter_add(
+                st.credits_posted, cr_ix, amounts, add_post & cr_mine
+            )
+            new_dp, u1 = u128.scatter_sub(new_dp, dr_ix, p_amount, sub_pend & dr_mine)
+            new_cp, u2 = u128.scatter_sub(new_cp, cr_ix, p_amount, sub_pend & cr_mine)
+            _, o5 = u128.add(new_dp, new_dpo)
+            _, o6 = u128.add(new_cp, new_cpo)
+            over_local = (
+                jnp.any(o1) | jnp.any(o2) | jnp.any(o3) | jnp.any(o4)
+                | jnp.any(o5) | jnp.any(o6) | jnp.any(u1) | jnp.any(u2)
+            )
+            over = jax.lax.psum(over_local.astype(jnp.uint32), "shard") > 0
+            return st._replace(
+                debits_pending=new_dp, debits_posted=new_dpo,
+                credits_pending=new_cp, credits_posted=new_cpo,
+            ), over
+
+        return commit_exact.create_transfers_exact_impl(
+            state, b, host_code, pending, chain_id,
+            balance_read=balance_read, balance_apply=balance_apply,
+        )
+
+    obs_spec = Observed(*([P()] * 4))
+    pending_spec = commit_exact.PendingInfo(*([P()] * 8))
+    sm = shard_map(
+        step,
+        mesh=mesh,
+        # Batch inputs replicated: the sweep is batch-global (see above).
+        in_specs=(state_specs(), TransferBatch(*([P()] * 10)),
+                  P(), pending_spec, P()),
+        out_specs=(state_specs(), P(), P(), obs_spec, obs_spec, P()),
+        check_vma=False,
+    )
+    return jax.jit(sm)
+
+
 def register_accounts_sharded(
     mesh: Mesh,
     state: LedgerState,

@@ -203,7 +203,7 @@ class Cluster:
         self.config = config
         self.net = PacketSimulator(seed, loss_probability=loss)
         self.zone = Zone.for_config(
-            config.journal_slot_count, config.message_size_max, config.clients_max,
+            config.journal_slot_count, config.message_size_max,
             grid_block_count=config.grid_block_count,
             grid_block_size=config.lsm_block_size,
         )
@@ -238,9 +238,12 @@ class Cluster:
 
     # --- fault injection -----------------------------------------------
 
-    def crash_replica(self, i: int) -> None:
+    def crash_replica(self, i: int, torn_write_probability: float = 0.0) -> None:
+        """Crash a replica; unsynced writes are lost with the given
+        probability (and may tear at sector boundaries — MemStorage.crash),
+        exercising journal/superblock recovery classification."""
         self.net.crashed.add(("replica", i))
-        self.storages[i].crash(torn_write_probability=0.0)
+        self.storages[i].crash(torn_write_probability=torn_write_probability)
         self.replicas[i] = None
 
     def restart_replica(self, i: int) -> None:

@@ -292,11 +292,11 @@ class Replica:
                 self._retry_pipeline()
             else:
                 if self.tick_count - self.last_heartbeat_tick >= NORMAL_HEARTBEAT_TIMEOUT:
-                    self._start_view_change(self.view + 1)
+                    self._vote_view_change(self.view + 1)
                 self._repair_gaps()
         elif self.status == STATUS_VIEW_CHANGE:
             if self.tick_count - self.last_heartbeat_tick >= VIEW_CHANGE_TIMEOUT:
-                self._start_view_change(self.view + 1)
+                self._vote_view_change(self.view + 1)
         elif self.status == STATUS_RECOVERING:
             self._recovering_tick()
 
@@ -317,9 +317,10 @@ class Replica:
         if (
             waited >= self.RECOVERING_ELECTION_WAIT
             and len(self._recovery_pongs) + 1 >= self.quorum_view_change
+            and self.tick_count % self.RECOVERING_PING_INTERVAL == 0
         ):
             views = [v for v, _ in self._recovery_pongs.values()]
-            self._start_view_change(max([self.view, *views]) + 1)
+            self._vote_view_change(max([self.view, *views]) + 1)
 
     # ------------------------------------------------------------------
     # message dispatch
@@ -518,6 +519,12 @@ class Replica:
     def on_prepare(self, msg: Message) -> None:
         h = msg.header
         if self.status != STATUS_NORMAL:
+            # A prepare at OUR view-change view can only come from a primary
+            # serving that view normally: the view change completed without
+            # us (our START_VIEW was lost) — adopt its outcome instead of
+            # wedging (VOPR seed 161).
+            if self.status == STATUS_VIEW_CHANGE and h["view"] >= self.view:
+                self._catch_up_throttled(h["view"])
             return
         op = h["op"]
         if op <= self.superblock.state.op_checkpoint:
@@ -640,6 +647,11 @@ class Replica:
             # (crashed/partitioned through it) — catch up via start_view.
             self._catch_up(h["view"])
             return
+        if self.status == STATUS_VIEW_CHANGE and h["view"] == self.view:
+            # The view we are changing into is already serving normally —
+            # its START_VIEW never reached us. Adopt it (VOPR seed 161).
+            self._catch_up_throttled(h["view"])
+            return
         if self.status != STATUS_NORMAL or h["view"] != self.view or self.is_primary:
             return
         self.last_heartbeat_tick = self.tick_count
@@ -650,14 +662,28 @@ class Replica:
         (reference request_start_view; replica.zig on_request_start_view).
         Non-disruptive: does not start a view change of its own."""
         self.last_heartbeat_tick = self.tick_count
+        self._last_rsv_tick = self.tick_count
         rsv = hdr.make(
             Command.REQUEST_START_VIEW, self.cluster,
             view=view, replica=self.replica,
         )
         self.bus.send_to_replica(self.primary_index(view), Message(rsv).seal())
 
+    RSV_THROTTLE = 20
+
+    def _catch_up_throttled(self, view: int) -> None:
+        """Per-prepare/commit escape hatch: rate-limit the RSV so a loaded
+        primary is not flooded with one request per prepare."""
+        if self.tick_count - getattr(self, "_last_rsv_tick", -1000) < self.RSV_THROTTLE:
+            return
+        self._catch_up(view)
+
     def on_request_start_view(self, msg: Message) -> None:
-        if not self.is_primary or msg.header["view"] != self.view:
+        if (
+            not self.is_primary
+            or msg.header["view"] != self.view
+            or self.status != STATUS_NORMAL
+        ):
             return
         sv = hdr.make(
             Command.START_VIEW, self.cluster,
@@ -1024,15 +1050,47 @@ class Replica:
 
     # --- view change ----------------------------------------------------
 
+    def _vote_view_change(self, new_view: int) -> None:
+        """Send START_VIEW_CHANGE for new_view WITHOUT leaving the current
+        status. The status transition is gated on an SVC quorum (reference
+        replica.zig on_start_view_change quorum): an isolated replica that
+        transitioned unilaterally would stop accepting current-view
+        heartbeats and its view would run away past the live cluster's,
+        wedging it permanently (observed at VOPR seed 142)."""
+        self.last_heartbeat_tick = self.tick_count
+        svc = hdr.make(
+            Command.START_VIEW_CHANGE, self.cluster,
+            view=new_view, replica=self.replica,
+        )
+        m = Message(svc).seal()
+        for r in range(self.replica_count):
+            if r != self.replica:
+                self.bus.send_to_replica(r, m)
+        self.start_view_change_from.setdefault(new_view, set()).add(self.replica)
+        self._maybe_enter_view_change(new_view)
+
+    def _maybe_enter_view_change(self, v: int) -> None:
+        """Enter view_change status for view v once a quorum of distinct
+        replicas (possibly excluding us) has voted for it."""
+        if v == self.view and self.status == STATUS_VIEW_CHANGE:
+            self._maybe_send_do_view_change(v)
+            return
+        if v <= self.view:
+            return
+        others = self.start_view_change_from.get(v, set()) - {self.replica}
+        if len(others) >= self.quorum_view_change - 1:
+            self._start_view_change(v)
+
     def _start_view_change(self, new_view: int) -> None:
-        if new_view <= self.view and self.status != STATUS_NORMAL:
-            new_view = self.view + 1
+        """Enter view_change for new_view (SVC quorum observed, or a DVC/SV
+        for the view proves one existed)."""
+        assert new_view > self.view or self.status != STATUS_NORMAL
         if self.status == STATUS_NORMAL:
             self.log_view = self.view
         self.status = STATUS_VIEW_CHANGE
         self.view = max(self.view, new_view)
         self.last_heartbeat_tick = self.tick_count
-        # The view promise must be durable BEFORE any SVC/DVC leaves this
+        # The view promise must be durable BEFORE any DVC leaves this
         # replica (reference view_durable): a replica that votes, crashes,
         # and restarts with the older view could otherwise ack prepares in
         # a view it promised to abandon, breaking quorum intersection.
@@ -1053,11 +1111,7 @@ class Replica:
         if v < self.view:
             return
         self.start_view_change_from.setdefault(v, set()).add(msg.header["replica"])
-        if v > self.view and self.status in (STATUS_NORMAL, STATUS_RECOVERING):
-            if len(self.start_view_change_from[v]) >= self.quorum_view_change - 1:
-                self._start_view_change(v)
-                return
-        self._maybe_send_do_view_change(v)
+        self._maybe_enter_view_change(v)
 
     def _maybe_send_do_view_change(self, v: int) -> None:
         if self.status != STATUS_VIEW_CHANGE or v != self.view:

@@ -137,20 +137,51 @@ class SuperBlock:
             self.storage.write(self._copy_offset(copy), self._encode(copy))
         self.storage.sync()
 
+    # A state is trusted only when this many copies carry the identical
+    # content (superblock_quorums.zig quorum threshold for 4 copies): a
+    # crashed checkpoint attempt can leave at most one torn singleton copy
+    # of its sequence, so demanding two identical copies excludes every
+    # frankenstein mix of same-sequence attempts while the two-wave write
+    # order guarantees the previous sequence still holds a quorum.
+    QUORUM = 2
+
     def open(self) -> VSRState:
-        """Pick the highest-sequence valid copy (quorum pick)."""
-        best: VSRState | None = None
-        valid = 0
+        """Pick the highest-sequence state backed by a checksum quorum."""
+        groups: dict[bytes, tuple[VSRState, int]] = {}
         for copy in range(COPIES):
             raw = self.storage.read(self._copy_offset(copy), SECTOR_SIZE)
             st = self._decode(raw)
             if st is None:
                 continue
-            valid += 1
+            # Identity = content without the copy index (bytes 16.. minus
+            # the copy field — compare the decoded state itself).
+            key = repr(st).encode()
+            prev = groups.get(key)
+            groups[key] = (st, (prev[1] if prev else 0) + 1)
+        best: VSRState | None = None
+        for st, count in groups.values():
+            if count < self.QUORUM:
+                continue
             if best is None or st.sequence > best.sequence:
                 best = st
         if best is None:
-            raise RuntimeError("no valid superblock copy — data file corrupt or unformatted")
-        assert valid >= 2, "superblock quorum lost"
+            raise RuntimeError(
+                "no superblock quorum — data file corrupt or unformatted"
+            )
         self.state = best
+        # Repair on open (superblock.zig): restore full redundancy before
+        # serving — otherwise one later latent sector error could roll the
+        # replica back past a state it already acked against. Only copies
+        # that DIFFER from the winner are rewritten: the existing quorum
+        # copies are never touched, so a crash mid-repair (tearing the
+        # in-flight rewrites) cannot reduce the surviving quorum.
+        repaired = False
+        for copy in range(COPIES):
+            want = self._encode(copy)
+            raw = self.storage.read(self._copy_offset(copy), SECTOR_SIZE)
+            if raw != want:
+                self.storage.write(self._copy_offset(copy), want)
+                repaired = True
+        if repaired:
+            self.storage.sync()
         return best
