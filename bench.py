@@ -539,17 +539,25 @@ def main() -> None:
     primary = results.get("config1_default", {})
     posted_per_s = float(primary.get("posted_per_s", 0.0))
     results["bench_wall_s"] = round(time.perf_counter() - t_start, 1)
-    print(
-        json.dumps(
-            {
-                "metric": "posted_transfers_per_sec",
-                "value": posted_per_s,
-                "unit": "tx/s",
-                "vs_baseline": round(posted_per_s / BASELINE_TPS, 3),
-                "extra": results,
-            }
+    record = {
+        "metric": "posted_transfers_per_sec",
+        "value": posted_per_s,
+        "unit": "tx/s",
+        "vs_baseline": round(posted_per_s / BASELINE_TPS, 3),
+        "extra": results,
+    }
+    # devhub-style local time series (reference devhub.zig:36-52): every
+    # bench run appends one JSON line so regressions are visible over time.
+    try:
+        from tigerbeetle_tpu import tracer
+
+        tracer.devhub_append(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)), "devhub.jsonl"),
+            record,
         )
-    )
+    except OSError:
+        pass
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,64 @@
+"""Tracer spans/counters + devhub series (reference tracer.zig, statsd.zig,
+devhub.zig analogs)."""
+
+import json
+
+from tigerbeetle_tpu import tracer
+
+
+def test_span_aggregation():
+    tracer.reset()
+    tracer.enable()
+    try:
+        for _ in range(3):
+            with tracer.span("unit.work"):
+                pass
+        tracer.count("unit.events", 5)
+        snap = tracer.snapshot()
+        assert snap["unit.work"]["count"] == 3
+        assert snap["unit.work"]["total_ms"] >= 0
+        assert snap["unit.events"]["count"] == 5
+        json.loads(tracer.emit_json())  # valid JSON
+    finally:
+        tracer.disable()
+        tracer.reset()
+
+
+def test_disabled_is_free_of_state():
+    tracer.reset()
+    tracer.disable()
+    with tracer.span("never"):
+        pass
+    tracer.count("never")
+    assert tracer.snapshot() == {}
+
+
+def test_spans_capture_commit_pipeline():
+    """Driving a replica with tracing on records the pipeline events."""
+    tracer.reset()
+    tracer.enable()
+    try:
+        from tigerbeetle_tpu.testing.cluster import Cluster, account_batch
+
+        from tests.test_cluster import do_request, setup_client
+        from tigerbeetle_tpu.vsr.header import Operation
+
+        cl = Cluster(replica_count=1)
+        c = setup_client(cl)
+        do_request(cl, c, Operation.CREATE_ACCOUNTS, account_batch([1, 2]))
+        snap = tracer.snapshot()
+        assert snap["replica.execute"]["count"] >= 1
+        assert snap["journal.write_prepare"]["count"] >= 1
+    finally:
+        tracer.disable()
+        tracer.reset()
+
+
+def test_devhub_append(tmp_path):
+    path = str(tmp_path / "devhub.jsonl")
+    tracer.devhub_append(path, {"metric": "x", "value": 1})
+    tracer.devhub_append(path, {"metric": "x", "value": 2})
+    lines = [json.loads(l) for l in open(path)]
+    assert len(lines) == 2
+    assert all("unix_timestamp" in r for r in lines)
+    assert lines[1]["value"] == 2

@@ -399,3 +399,47 @@ class TestViewChangeAdoption:
         assert rp.op <= max(new_op, base_op + 1) or rp.journal.read_prepare(
             base_op + 2
         ) is None
+
+
+class TestDeepBacklogRepair:
+    def test_catch_up_beyond_headers_window(self):
+        """A backup partitioned through 120+ committed ops (deeper than the
+        32-header SV window AND the 64-header REQUEST_HEADERS page) must
+        catch up through WAL repair alone — the paged header walk
+        (replica.zig:2131) fetches windows until every hole is filled.
+        Committed prefixes are unique, so depth is a liveness concern, not
+        a divergence one (replica.VIEW_HEADERS_WINDOW invariant)."""
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            TEST_MIN, name="deep", journal_slot_count=256, checkpoint_interval=1 << 30
+        )
+        cl = Cluster(replica_count=3, config=cfg, seed=9)
+        c = setup_client(cl)
+        do_request(cl, c, Operation.CREATE_ACCOUNTS, account_batch([1, 2]))
+
+        # Isolate replica 2 from both peers, then commit 120 ops.
+        cl.net.partition(("replica", 2), ("replica", 0))
+        cl.net.partition(("replica", 2), ("replica", 1))
+        from tigerbeetle_tpu.testing.cluster import transfer_batch
+
+        for i in range(120):
+            do_request(
+                cl, c, Operation.CREATE_TRANSFERS,
+                transfer_batch([
+                    dict(id=1 + i, debit_account_id=1, credit_account_id=2,
+                         amount=1, ledger=1, code=1),
+                ]),
+            )
+        lagger = cl.replicas[2]
+        committed = max(r.commit_min for r in cl.replicas if r is not None)
+        assert committed - lagger.commit_min > 100  # deeper than any window
+
+        # Heal: the lagger must converge via header pages + prepares,
+        # never via snapshot sync (its WAL still covers everything).
+        cl.net.heal()
+        cl.run_until(
+            lambda: cl.replicas[2].commit_min >= committed, max_ticks=120_000
+        )
+        assert cl.replicas[2]._sync is None  # WAL repair, not state sync
+        cl.check_state_convergence()

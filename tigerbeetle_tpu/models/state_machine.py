@@ -25,7 +25,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from tigerbeetle_tpu import types
+from tigerbeetle_tpu import tracer, types
 from tigerbeetle_tpu.constants import Config, PRODUCTION
 from tigerbeetle_tpu.flags import AccountFlags, TransferFlags
 from tigerbeetle_tpu.lsm.store import (
@@ -413,7 +413,8 @@ class StateMachine:
             hard = bool(np.any(hit == 0))
         if hard:
             self.stats["serial_batches"] += 1
-            return self._create_transfers_serial(events, timestamp)
+            with tracer.span("sm.create_transfers.serial"):
+                return self._create_transfers_serial(events, timestamp)
 
         dr_keys = pack_keys(events["debit_account_id_lo"], events["debit_account_id_hi"])
         cr_keys = pack_keys(events["credit_account_id_lo"], events["credit_account_id_hi"])
@@ -470,11 +471,15 @@ class StateMachine:
             )
 
         if exact_needed:
-            return self._create_transfers_exact(
-                events, ts, dr_slots, cr_slots, host_code, timestamp, is_pv, pv_keys
-            )
+            with tracer.span("sm.create_transfers.exact"):
+                return self._create_transfers_exact(
+                    events, ts, dr_slots, cr_slots, host_code, timestamp, is_pv, pv_keys
+                )
         b, host_code_p = self._device_batch(events, ts, dr_slots, cr_slots, host_code)
-        new_state, codes_dev, bail = self._ops.create_transfers_fast(self.state, b, host_code_p)
+        with tracer.span("sm.create_transfers.fast"):
+            new_state, codes_dev, bail = self._ops.create_transfers_fast(
+                self.state, b, host_code_p
+            )
         if bool(bail):
             self.stats["bail_batches"] += 1
             return self._create_transfers_serial(events, timestamp)

@@ -202,11 +202,14 @@ def make_sharded_commit_exact(mesh: Mesh, accounts_max: int):
             local = glob - base_off
             mine = (local >= 0) & (local < rows)
             lclip = jnp.clip(local, 0, rows - 1)
-            out = []
-            for f in BAL_FIELDS:
-                v = jnp.where(mine[:, None], getattr(st, f)[lclip], jnp.uint32(0))
-                out.append(jax.lax.psum(v, "shard"))
-            return out
+            stacked = jnp.stack(
+                [
+                    jnp.where(mine[:, None], getattr(st, f)[lclip], jnp.uint32(0))
+                    for f in BAL_FIELDS
+                ]
+            )
+            gathered = jax.lax.psum(stacked, "shard")  # ONE collective
+            return [gathered[i] for i in range(len(BAL_FIELDS))]
 
         def balance_apply(
             st, eff_dr, eff_cr, amounts, p_amount, add_pend, add_post, sub_pend

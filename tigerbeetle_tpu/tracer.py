@@ -1,0 +1,111 @@
+"""Tracing, metrics, and the devhub-style benchmark series.
+
+The analog of the reference's observability stack:
+
+  - /root/reference/src/tracer.zig:48 — typed span events around the
+    commit pipeline (start/end pairs, slot-based). Here: `span(event)`
+    context manager aggregating count/total/max nanoseconds per event
+    name, near-zero overhead when disabled (one dict lookup + two
+    perf_counter_ns calls when enabled, nothing when not).
+  - /root/reference/src/statsd.zig:12 — metric emission. Here: `snapshot()`
+    returns the aggregate table; `emit_json()` renders one JSON object
+    (processes scrape it instead of UDP StatsD — no daemon dependency).
+  - /root/reference/src/scripts/devhub.zig:36-52 — the per-merge benchmark
+    time series. Here: `devhub_append(path, record)` appends one JSON line
+    with a wall-clock stamp; bench.py calls it so every bench run extends
+    a local `devhub.jsonl` database (the reference renders the same shape
+    with devhub.js).
+
+Spans are process-local and single-threaded (the replica is one event
+loop, like the reference); enable with TIGERBEETLE_TPU_TRACE=1 or
+`tracer.enable()`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from contextlib import contextmanager
+from typing import Dict
+
+_enabled = os.environ.get("TIGERBEETLE_TPU_TRACE", "") not in ("", "0")
+
+# event → [count, total_ns, max_ns]
+_events: Dict[str, list] = {}
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def reset() -> None:
+    _events.clear()
+
+
+@contextmanager
+def span(event: str):
+    """Time a scoped region under `event` (tracer.zig start/end)."""
+    if not _enabled:
+        yield
+        return
+    t0 = time.perf_counter_ns()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter_ns() - t0
+        rec = _events.get(event)
+        if rec is None:
+            _events[event] = [1, dt, dt]
+        else:
+            rec[0] += 1
+            rec[1] += dt
+            if dt > rec[2]:
+                rec[2] = dt
+
+
+def count(event: str, n: int = 1) -> None:
+    """Bump a counter without timing (statsd.zig counter semantics)."""
+    if not _enabled:
+        return
+    rec = _events.get(event)
+    if rec is None:
+        _events[event] = [n, 0, 0]
+    else:
+        rec[0] += n
+
+
+def snapshot() -> Dict[str, dict]:
+    """event → {count, total_ms, avg_us, max_us}."""
+    out = {}
+    for event, (n, total, mx) in sorted(_events.items()):
+        out[event] = {
+            "count": n,
+            "total_ms": round(total / 1e6, 3),
+            "avg_us": round(total / n / 1e3, 1) if n else 0.0,
+            "max_us": round(mx / 1e3, 1),
+        }
+    return out
+
+
+def emit_json() -> str:
+    return json.dumps(snapshot())
+
+
+def devhub_append(path: str, record: dict) -> None:
+    """Append one benchmark record to the JSON-lines series
+    (devhub.zig:36-52's git-backed database, minus the git)."""
+    rec = dict(record)
+    rec.setdefault("unix_timestamp", int(time.time()))
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+from tigerbeetle_tpu import tracer
 from tigerbeetle_tpu.io.storage import Zone
 from tigerbeetle_tpu.vsr.header import HEADER_SIZE, Command, Header, Message
 
@@ -44,6 +45,10 @@ class Journal:
         return h is None or h["op"] <= op
 
     def write_prepare(self, message: Message, sync: bool = True) -> None:
+        with tracer.span("journal.write_prepare"):
+            self._write_prepare(message, sync)
+
+    def _write_prepare(self, message: Message, sync: bool = True) -> None:
         """Durably store a prepare in its slot (body ring then header ring;
         reference replica.zig:8454 writes sectors of both rings)."""
         assert message.header["command"] == Command.PREPARE

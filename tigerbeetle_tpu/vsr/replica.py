@@ -23,11 +23,13 @@ an identical execution, byte-for-byte (SURVEY.md §4 keystone).
 
 from __future__ import annotations
 
+import logging
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
 from tigerbeetle_tpu import types
+from tigerbeetle_tpu import tracer
 from tigerbeetle_tpu.constants import Config
 from tigerbeetle_tpu.io.storage import Zone
 from tigerbeetle_tpu.models.state_machine import StateMachine
@@ -41,6 +43,10 @@ from tigerbeetle_tpu.vsr.superblock import SuperBlock, VSRState
 STATUS_NORMAL = "normal"
 STATUS_VIEW_CHANGE = "view_change"
 STATUS_RECOVERING = "recovering"
+
+# Scoped logger (reference std.log scoped loggers; silent unless the
+# embedder configures logging — the simulator leaves it off for speed).
+log = logging.getLogger("tigerbeetle_tpu.replica")
 
 # Tick counts (the reference's timeouts, replica.zig:2535-2861, scaled to
 # abstract ticks; the production loop maps ticks to ~10ms).
@@ -679,11 +685,9 @@ class Replica:
         self._catch_up(view)
 
     def on_request_start_view(self, msg: Message) -> None:
-        if (
-            not self.is_primary
-            or msg.header["view"] != self.view
-            or self.status != STATUS_NORMAL
-        ):
+        # is_primary is False in any non-normal status, so this also
+        # rejects RSVs while we are mid-view-change ourselves.
+        if not self.is_primary or msg.header["view"] != self.view:
             return
         sv = hdr.make(
             Command.START_VIEW, self.cluster,
@@ -1070,15 +1074,17 @@ class Replica:
         self._maybe_enter_view_change(new_view)
 
     def _maybe_enter_view_change(self, v: int) -> None:
-        """Enter view_change status for view v once a quorum of distinct
-        replicas (possibly excluding us) has voted for it."""
+        """Enter view_change status for view v once a full quorum of
+        distinct replicas has ACTUALLY voted for it (our own vote counts
+        only if we sent one — reference replica.zig:1712-1727). A single
+        flaky replica's lone SVC must never pull a healthy cluster out of
+        normal processing."""
         if v == self.view and self.status == STATUS_VIEW_CHANGE:
             self._maybe_send_do_view_change(v)
             return
         if v <= self.view:
             return
-        others = self.start_view_change_from.get(v, set()) - {self.replica}
-        if len(others) >= self.quorum_view_change - 1:
+        if len(self.start_view_change_from.get(v, set())) >= self.quorum_view_change:
             self._start_view_change(v)
 
     def _start_view_change(self, new_view: int) -> None:
@@ -1087,6 +1093,7 @@ class Replica:
         assert new_view > self.view or self.status != STATUS_NORMAL
         if self.status == STATUS_NORMAL:
             self.log_view = self.view
+        log.info("replica %d: view_change -> view %d", self.replica, new_view)
         self.status = STATUS_VIEW_CHANGE
         self.view = max(self.view, new_view)
         self.last_heartbeat_tick = self.tick_count
@@ -1139,12 +1146,22 @@ class Replica:
         else:
             self.bus.send_to_replica(primary, m)
 
+    # DVC/SV bodies carry this many trailing headers. Soundness bound:
+    # divergent content can only exist in an UNCOMMITTED suffix, whose
+    # length is capped by the prepare pipeline (pipeline_max = 8 in
+    # flight, reference config.zig:133) — committed prefixes are unique by
+    # quorum intersection, so ops below the window can be *missing* on a
+    # lagging backup (repaired via the paged REQUEST_HEADERS walk,
+    # tests/test_view_change.py deep-backlog scenario) but never wrong.
+    # 32 = 4x pipeline_max margin.
+    VIEW_HEADERS_WINDOW = 32
+
     def _sv_body_headers(self) -> List[Header]:
         """Headers describing the WINNING log for a START_VIEW body: where a
         repair target exists the local journal is stale, so the target
         header is authoritative; elsewhere the journal entry is."""
         out = []
-        for op in range(max(1, self.op - 32), self.op + 1):
+        for op in range(max(1, self.op - self.VIEW_HEADERS_WINDOW), self.op + 1):
             target = self.repair_target.get(op)
             if target is not None:
                 out.append(target)
@@ -1367,6 +1384,10 @@ class Replica:
         return rt if rt is not None else self.time.realtime_ns()
 
     def _execute(self, prepare: Message, replay: bool = False) -> Optional[Message]:
+        with tracer.span("replica.execute"):
+            return self._execute_inner(prepare, replay)
+
+    def _execute_inner(self, prepare: Message, replay: bool = False) -> Optional[Message]:
         h = prepare.header
         op_num = h["op"]
         operation = h["operation"]
@@ -1472,6 +1493,8 @@ class Replica:
             return
         if self.commit_min <= self.superblock.state.op_checkpoint:
             return
+        log.info("replica %d: checkpoint at op %d", self.replica, self.commit_min)
+        tracer.count("replica.checkpoint")
         if self.snapshot_store is not None:
             # encode() flushes LSM memtables into grid blocks; those blocks
             # must be durable before the superblock may reference them.
