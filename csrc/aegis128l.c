@@ -1,0 +1,100 @@
+/* AEGIS-128L MAC — the native checksum shim.
+ *
+ * The reference seals every header, body, and grid block with AEGIS-128L
+ * (zero key) because one AES round per 16 bytes runs at memory speed on
+ * AES-NI hardware (/root/reference/src/vsr/checksum.zig:1-45, Zig
+ * std.crypto.aead.Aegis128LMac). This shim is the same construction for
+ * the TPU build's host runtime: data absorbed as associated data, zero
+ * key/nonce, 128-bit tag. Python binds it via ctypes
+ * (tigerbeetle_tpu/native); byte-stability is cross-checked against a
+ * pure-Python implementation of the same spec
+ * (tests/test_native_checksum.py).
+ *
+ * Spec: draft-irtf-cfrg-aegis-aead (AEGIS-128L state update / finalize).
+ *
+ * Build: cc -O3 -maes -mssse3 -shared -fPIC aegis128l.c -o libaegis128l.so
+ */
+
+#include <stdint.h>
+#include <string.h>
+#include <wmmintrin.h>
+#include <tmmintrin.h>
+
+typedef __m128i blk;
+
+static const uint8_t C0_BYTES[16] = {
+    0x00, 0x01, 0x01, 0x02, 0x03, 0x05, 0x08, 0x0d,
+    0x15, 0x22, 0x37, 0x59, 0x90, 0xe9, 0x79, 0x62,
+};
+static const uint8_t C1_BYTES[16] = {
+    0xdb, 0x3d, 0x18, 0x55, 0x6d, 0xc2, 0x2f, 0xf1,
+    0x20, 0x11, 0x31, 0x42, 0x73, 0xb5, 0x28, 0xdd,
+};
+
+/* One AEGIS-128L state update with a 256-bit message block (m0, m1). */
+static inline void update(blk s[8], blk m0, blk m1) {
+    blk s7 = s[7];
+    blk t0 = s[0], t1 = s[1], t2 = s[2], t3 = s[3];
+    blk t4 = s[4], t5 = s[5], t6 = s[6];
+    s[0] = _mm_aesenc_si128(s7, _mm_xor_si128(t0, m0));
+    s[1] = _mm_aesenc_si128(t0, t1);
+    s[2] = _mm_aesenc_si128(t1, t2);
+    s[3] = _mm_aesenc_si128(t2, t3);
+    s[4] = _mm_aesenc_si128(t3, _mm_xor_si128(t4, m1));
+    s[5] = _mm_aesenc_si128(t4, t5);
+    s[6] = _mm_aesenc_si128(t5, t6);
+    s[7] = _mm_aesenc_si128(t6, s7);
+}
+
+/* 128-bit AEGIS-128L MAC of `len` bytes of `data` (absorbed as associated
+ * data; zero key, zero nonce, empty message), written to `tag_out[16]`. */
+void aegis128l_mac(const uint8_t *data, uint64_t len, uint8_t *tag_out) {
+    const blk c0 = _mm_loadu_si128((const blk *)C0_BYTES);
+    const blk c1 = _mm_loadu_si128((const blk *)C1_BYTES);
+    const blk zero = _mm_setzero_si128(); /* key = nonce = 0 */
+
+    blk s[8];
+    s[0] = zero;              /* key ^ nonce */
+    s[1] = c1;
+    s[2] = c0;
+    s[3] = c1;
+    s[4] = zero;              /* key ^ nonce */
+    s[5] = c0;                /* key ^ C0 */
+    s[6] = c1;                /* key ^ C1 */
+    s[7] = c0;                /* key ^ C0 */
+    for (int i = 0; i < 10; i++) {
+        update(s, zero, zero); /* Update(nonce, key) */
+    }
+
+    uint64_t off = 0;
+    while (len - off >= 32) {
+        blk m0 = _mm_loadu_si128((const blk *)(data + off));
+        blk m1 = _mm_loadu_si128((const blk *)(data + off + 16));
+        update(s, m0, m1);
+        off += 32;
+    }
+    uint64_t rem = len - off;
+    if (rem) {
+        uint8_t pad[32];
+        memset(pad, 0, 32);
+        memcpy(pad, data + off, rem);
+        blk m0 = _mm_loadu_si128((const blk *)pad);
+        blk m1 = _mm_loadu_si128((const blk *)(pad + 16));
+        update(s, m0, m1);
+    }
+
+    /* Finalize: tmp = S2 ^ (LE64(ad_bits) || LE64(msg_bits)); 7 updates. */
+    uint64_t lens[2] = {len * 8u, 0u};
+    blk lenblk = _mm_loadu_si128((const blk *)lens);
+    blk tmp = _mm_xor_si128(s[2], lenblk);
+    for (int i = 0; i < 7; i++) {
+        update(s, tmp, tmp);
+    }
+    blk tag = _mm_xor_si128(s[0], s[1]);
+    tag = _mm_xor_si128(tag, s[2]);
+    tag = _mm_xor_si128(tag, s[3]);
+    tag = _mm_xor_si128(tag, s[4]);
+    tag = _mm_xor_si128(tag, s[5]);
+    tag = _mm_xor_si128(tag, s[6]);
+    _mm_storeu_si128((blk *)tag_out, tag);
+}

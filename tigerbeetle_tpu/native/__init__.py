@@ -1,0 +1,101 @@
+"""Native (C) shims for the host runtime.
+
+The TPU compute path is JAX/XLA; the host runtime around it uses native
+code where the reference does (SURVEY §7 hard part (f)): AEGIS-128L
+checksums run one AES round per 16 bytes on AES-NI hardware — an order of
+magnitude past any software hash, and every message header/body and grid
+block is sealed with one (reference src/vsr/checksum.zig).
+
+The shim self-builds from csrc/aegis128l.c with the system compiler on
+first import (cached next to the source) and loads via ctypes — no
+pybind11 dependency. Hosts without AES-NI or a C compiler fall back to
+BLAKE2b-128 transparently (vsr/header.py); the two algorithms are format-
+incompatible, so a deployment picks one via TIGERBEETLE_TPU_CHECKSUM and
+all replicas of a cluster must agree (the same class of constraint as the
+reference's fixed AEGIS choice).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Callable, Optional
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "csrc", "aegis128l.c",
+)
+_LIB = os.path.join(os.path.dirname(_SRC), "libaegis128l.so")
+
+_mac: Optional[Callable[[bytes], bytes]] = None
+_tried = False
+
+
+def _cpu_has_aes() -> bool:
+    import platform
+
+    # x86-only shim (wmmintrin intrinsics); ARM also spells its feature
+    # flag "aes", so gate on the architecture first.
+    if platform.machine() not in ("x86_64", "amd64", "AMD64"):
+        return False
+    try:
+        with open("/proc/cpuinfo") as f:
+            return " aes " in f.read().replace("\n", " ")
+    except OSError:
+        return False
+
+
+def _build() -> bool:
+    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+        return True
+    tmp = f"{_LIB}.{os.getpid()}.tmp"  # pid-unique: concurrent first
+    # builds must not interleave into one output (os.replace is atomic)
+    for cc in ("cc", "gcc", "clang"):
+        try:
+            r = subprocess.run(
+                [cc, "-O3", "-maes", "-mssse3", "-shared", "-fPIC",
+                 _SRC, "-o", tmp],
+                capture_output=True, timeout=60,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if r.returncode == 0:
+            os.replace(tmp, _LIB)
+            return True
+    try:
+        os.unlink(tmp)
+    except OSError:
+        pass
+    return False
+
+
+def aegis128l_mac() -> Optional[Callable[[bytes], bytes]]:
+    """Returns bytes -> 16-byte tag, or None if unavailable on this host."""
+    global _mac, _tried
+    if _tried:
+        return _mac
+    _tried = True
+    if not _cpu_has_aes() or not os.path.exists(_SRC):
+        return None
+    if not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB)
+    except OSError:
+        return None
+    fn = lib.aegis128l_mac
+    fn.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p]
+    fn.restype = None
+
+    def mac(data: bytes) -> bytes:
+        out = ctypes.create_string_buffer(16)
+        fn(data, len(data), out)
+        return out.raw
+
+    # Smoke: deterministic and length-sensitive before we trust it.
+    a, b = mac(b"x"), mac(b"x")
+    if a != b or mac(b"y") == a or mac(b"") == a:
+        return None
+    _mac = mac
+    return _mac

@@ -3,11 +3,13 @@
 Mirrors the reference's extern-struct header
 (/root/reference/src/vsr/message_header.zig:17-70): every message is a
 256-byte header + ≤(1 MiB − 256 B) body; `checksum` covers the header bytes
-after itself, `checksum_body` covers the body. The reference uses AEGIS-128L
-with a zero key as a universal MAC (vsr/checksum.zig:1-45); hardware-AES is
-not reachable from Python, so this build uses keyed BLAKE2b truncated to
-128 bits — stable on disk/wire, swappable for a native AEGIS shim later
-(the checksum function is a single seam, `checksum()` below).
+after itself, `checksum_body` covers the body. Like the reference
+(vsr/checksum.zig:1-45), the MAC is AEGIS-128L with a zero key — via the
+native AES-NI shim (tigerbeetle_tpu/native, csrc/aegis128l.c) at ~11 GB/s.
+Hosts without the shim use BLAKE2b-128. The two are format-incompatible:
+TIGERBEETLE_TPU_CHECKSUM pins the choice (auto | aegis | blake2b), every
+replica of a cluster and the data files it wrote must agree, and an
+explicit `aegis` request fails loudly when the shim is unavailable.
 """
 
 from __future__ import annotations
@@ -105,9 +107,45 @@ HEADER_DTYPE = np.dtype(
 assert HEADER_DTYPE.itemsize == HEADER_SIZE
 
 
+def _select_checksum():
+    """Pick the checksum backend once at import (see module docstring):
+    auto → aegis128l when the native shim loads, else blake2b;
+    aegis/aegis128l → required, raise if the shim is unavailable;
+    blake2b → portable fallback. Unknown values raise (a typo silently
+    picking the wrong algorithm would present as data corruption)."""
+    import os
+
+    choice = os.environ.get("TIGERBEETLE_TPU_CHECKSUM", "auto")
+    if choice not in ("auto", "aegis", "aegis128l", "blake2b"):
+        raise ValueError(
+            f"TIGERBEETLE_TPU_CHECKSUM={choice!r}: expected auto|aegis|blake2b"
+        )
+    if choice != "blake2b":
+        from tigerbeetle_tpu import native
+
+        mac = native.aegis128l_mac()
+        if mac is not None:
+            return lambda data: int.from_bytes(mac(bytes(data)), "little"), "aegis128l"
+        if choice in ("aegis", "aegis128l"):
+            raise RuntimeError(
+                "TIGERBEETLE_TPU_CHECKSUM=aegis requested but the native "
+                "shim is unavailable on this host (no AES-NI x86 CPU or no "
+                "C compiler) — refusing a silent format-incompatible fallback"
+            )
+    return (
+        lambda data: int.from_bytes(
+            hashlib.blake2b(bytes(data), digest_size=16).digest(), "little"
+        ),
+        "blake2b",
+    )
+
+
+_checksum_fn, CHECKSUM_ALGORITHM = _select_checksum()
+
+
 def checksum(data: bytes | memoryview) -> int:
-    """128-bit MAC (BLAKE2b-128; the reference's AEGIS seam)."""
-    return int.from_bytes(hashlib.blake2b(bytes(data), digest_size=16).digest(), "little")
+    """128-bit MAC over headers, bodies, and grid blocks."""
+    return _checksum_fn(data)
 
 
 class Header:
