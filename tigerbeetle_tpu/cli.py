@@ -101,6 +101,11 @@ def cmd_start(args) -> int:
 
     addresses = parse_addresses(args.addresses)
     storage = FileStorage(args.path)
+    aof = None
+    if args.aof:
+        from tigerbeetle_tpu.vsr.aof import AOF
+
+        aof = AOF(args.path + ".aof")
     replica = Replica(
         cluster=args.cluster,
         replica_index=args.replica,
@@ -112,6 +117,7 @@ def cmd_start(args) -> int:
         snapshot_store=FileSnapshotStore(args.path),
         sm_backend=args.backend,
         time=SystemTime(),
+        aof=aof,
     )
     server = ReplicaServer(replica, addresses)
     replica.open()
@@ -311,6 +317,41 @@ def cmd_benchmark(args) -> int:
     return 0
 
 
+def cmd_aof(args) -> int:
+    """AOF tooling (reference `aof merge/debug` + validator, aof.zig)."""
+    from tigerbeetle_tpu.vsr import aof as aof_mod
+
+    if args.aof_cmd == "debug":
+        n = 0
+        for m, primary, replica in aof_mod.iter_entries(args.paths[0]):
+            h = m.header
+            print(f"op={h['op']} operation={h['operation']} view={h['view']} "
+                  f"size={h['size']} primary={primary} replica={replica}")
+            n += 1
+        print(f"{n} entries")
+    elif args.aof_cmd == "merge":
+        msgs = aof_mod.merge(args.paths)
+        print(f"merged {len(args.paths)} AOFs -> {len(msgs)} contiguous ops "
+              f"[{msgs[0].header['op']}..{msgs[-1].header['op']}]" if msgs
+              else "merged: empty")
+        if args.out and msgs:
+            out = aof_mod.AOF(args.out)
+            for m in msgs:
+                out.append(m, 0, 0)
+            out.sync()
+            out.close()
+            print(f"wrote {args.out}")
+    elif args.aof_cmd == "recover":
+        from tigerbeetle_tpu.constants import config_by_name
+
+        sm, last_op = aof_mod.recover(
+            args.paths, config=config_by_name(args.config), backend="numpy"
+        )
+        print(f"recovered to op {last_op}: {sm.account_count} accounts, "
+              f"{sm.transfer_log.count} transfers")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tigerbeetle-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -330,7 +371,17 @@ def main(argv=None) -> int:
     s.add_argument("--cluster", type=int, default=0)
     s.add_argument("--config", default="production")
     s.add_argument("--backend", default="jax", choices=["jax", "numpy"])
+    s.add_argument("--aof", action="store_true",
+                   help="append committed prepares to <path>.aof")
     s.set_defaults(fn=cmd_start)
+
+    a = sub.add_parser("aof", help="AOF debug/merge/recover tooling")
+    a.add_argument("aof_cmd", choices=["debug", "merge", "recover"])
+    a.add_argument("paths", nargs="+")
+    a.add_argument("--out", default=None)
+    a.add_argument("--config", default="production",
+                   help="state-machine sizing for recover (match the cluster)")
+    a.set_defaults(fn=cmd_aof)
 
     v = sub.add_parser("version")
     v.set_defaults(fn=lambda a: (print(f"tigerbeetle-tpu {VERSION}"), 0)[1])

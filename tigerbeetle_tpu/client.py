@@ -154,6 +154,11 @@ class Client:
                     if sk in readable:
                         for reply in self._pump(r):
                             h = reply.header
+                            if h["command"] == Command.PONG_CLIENT:
+                                # Hello answer: aim at the view's primary
+                                # (reference client view discovery).
+                                self._target = h["view"] % len(self.addresses)
+                                continue
                             if h["command"] == Command.EVICTION:
                                 # The session is gone server-side; allow a
                                 # fresh register() to establish a new one.

@@ -164,6 +164,16 @@ class ReplicaServer:
                 # the client, and must win over any stale/forwarded mapping.
                 client_id = h["client"]
                 self.client_conns[client_id] = conn
+                # Answer with the current view so the client can aim its
+                # first request at the primary instead of trial-rotating
+                # (reference ping_client/pong_client, vsr/client.zig view
+                # discovery).
+                r = self.replica
+                pong = Header(
+                    None, command=Command.PONG_CLIENT, cluster=r.cluster,
+                    replica=self.me, view=r.view, client=client_id,
+                )
+                conn.send(Message(pong).seal().to_bytes())
                 continue  # hello is transport-level, not for the replica
             if cmd == Command.REQUEST:
                 # Map only direct client connections: a REQUEST arriving on

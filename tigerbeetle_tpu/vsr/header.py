@@ -41,7 +41,9 @@ class Command:
     REQUEST_START_VIEW = 13
     REQUEST_HEADERS = 14
     REQUEST_PREPARE = 15
-    REQUEST_REPLY = 16
+    # 16 (reference request_reply) is intentionally absent: replies are
+    # rebuilt deterministically by WAL replay on every replica, so no
+    # replica can be missing one it needs (see Zone.for_config).
     HEADERS = 17
     EVICTION = 18
     REQUEST_SYNC_CHECKPOINT = 19

@@ -109,6 +109,7 @@ class Replica:
         sm_backend: str = "numpy",
         on_event: Optional[Callable[[str, "Replica"], None]] = None,
         time=None,
+        aof=None,
     ) -> None:
         self.cluster = cluster
         self.replica = replica_index
@@ -119,6 +120,9 @@ class Replica:
         self.bus = bus
         self.snapshot_store = snapshot_store
         self.sm_backend = sm_backend
+        # Optional append-only file of committed prepares (vsr/aof.py;
+        # reference hook at replica.zig:3745).
+        self.aof = aof
         self.on_event = on_event or (lambda kind, r: None)
 
         self.superblock = SuperBlock(storage, zone)
@@ -1384,6 +1388,13 @@ class Replica:
         return rt if rt is not None else self.time.realtime_ns()
 
     def _execute(self, prepare: Message, replay: bool = False) -> Optional[Message]:
+        if self.aof is not None:
+            # Replay included: ops whose AOF entries died in the page cache
+            # (power loss after commit) are re-offered by WAL replay and
+            # must fill the gap; AOF.append skips ops already recorded.
+            self.aof.append(
+                prepare, self.primary_index(prepare.header["view"]), self.replica
+            )
         with tracer.span("replica.execute"):
             return self._execute_inner(prepare, replay)
 
@@ -1495,6 +1506,8 @@ class Replica:
             return
         log.info("replica %d: checkpoint at op %d", self.replica, self.commit_min)
         tracer.count("replica.checkpoint")
+        if self.aof is not None:
+            self.aof.sync()
         if self.snapshot_store is not None:
             # encode() flushes LSM memtables into grid blocks; those blocks
             # must be durable before the superblock may reference them.

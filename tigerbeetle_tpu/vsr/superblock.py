@@ -166,7 +166,9 @@ class SuperBlock:
                 best = st
         if best is None:
             raise RuntimeError(
-                "no superblock quorum — data file corrupt or unformatted"
+                "no superblock quorum — data file corrupt, unformatted, or "
+                "written under a different TIGERBEETLE_TPU_CHECKSUM "
+                "algorithm (set it explicitly to match the formatter's)"
             )
         self.state = best
         # Repair on open (superblock.zig): restore full redundancy before
