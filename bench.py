@@ -471,6 +471,8 @@ def bench_config5_lsm():
     # Timing note: block_until_ready on axon is only reliable for array
     # outputs (scalar sync can return early), so block on the merged arrays
     # and keep the dispatch queue full with sequential calls.
+    from tigerbeetle_tpu.ops.merge import merge_kernel_tiled as merge_kernel  # noqa: F811
+
     ok, ov = merge_kernel(ja, jva, jb, jva)
     np.asarray(ov)  # force warmup completion
     reps = 8

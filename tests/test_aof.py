@@ -5,7 +5,6 @@ cluster's state byte-for-byte)."""
 
 import numpy as np
 
-from tigerbeetle_tpu import types
 from tigerbeetle_tpu.testing.cluster import (
     Cluster,
     account_batch,

@@ -322,13 +322,15 @@ def cmd_aof(args) -> int:
     from tigerbeetle_tpu.vsr import aof as aof_mod
 
     if args.aof_cmd == "debug":
-        n = 0
-        for m, primary, replica in aof_mod.iter_entries(args.paths[0]):
-            h = m.header
-            print(f"op={h['op']} operation={h['operation']} view={h['view']} "
-                  f"size={h['size']} primary={primary} replica={replica}")
-            n += 1
-        print(f"{n} entries")
+        for path in args.paths:
+            n = 0
+            for m, primary, replica in aof_mod.iter_entries(path):
+                h = m.header
+                print(f"{path}: op={h['op']} operation={h['operation']} "
+                      f"view={h['view']} size={h['size']} "
+                      f"primary={primary} replica={replica}")
+                n += 1
+            print(f"{path}: {n} entries")
     elif args.aof_cmd == "merge":
         msgs = aof_mod.merge(args.paths)
         print(f"merged {len(args.paths)} AOFs -> {len(msgs)} contiguous ops "

@@ -51,7 +51,14 @@ class AOF:
             expect = None
             for m, _, _ in iter_entries(path):
                 op = m.header["op"]
-                if expect is not None and op != expect:
+                if expect is None:
+                    # Anchor only at the true start of history: if the
+                    # first entry was lost to corruption, the mark must
+                    # stay 0 so WAL replay can backfill it (a later-op
+                    # anchor would wrongly mark the gap as recorded).
+                    if op > 1:
+                        break
+                elif op != expect:
                     break
                 self._last_contiguous = op
                 expect = op + 1
@@ -84,9 +91,15 @@ class AOF:
 def iter_entries(path: str) -> Iterator[Tuple[Message, int, int]]:
     """Yield (prepare, primary, replica) from an AOF, skipping corrupt
     regions by scanning forward for the magic marker (aof.zig's
-    extreme-corruption recovery)."""
+    extreme-corruption recovery). The file is memory-mapped, not slurped —
+    AOFs grow without bound and replicas rescan them at every start."""
+    import mmap
+
     with open(path, "rb") as f:
-        data = f.read()
+        try:
+            data = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        except ValueError:  # empty file
+            return
     pos = 0
     n = len(data)
     while pos + ENTRY_HEADER_SIZE <= n:
@@ -163,7 +176,6 @@ def recover(paths: List[str], config=None, backend: str = "numpy"):
     validator). Returns (state_machine, last_op)."""
     import numpy as np
 
-    from tigerbeetle_tpu import types
     from tigerbeetle_tpu.constants import TEST_MIN
     from tigerbeetle_tpu.models.state_machine import StateMachine
     from tigerbeetle_tpu.vsr.header import Operation
