@@ -46,18 +46,21 @@ class AOF:
 
     def __init__(self, path: str) -> None:
         self.path = path
+        # The file legitimately starts past op 1 when this replica joined
+        # via state sync (it never executed the pre-checkpoint prefix), so
+        # anchor the contiguity mark at the first readable entry and track
+        # it: a replayed op BELOW the anchor is evidence the original
+        # first entries were lost to corruption — those are re-appended
+        # (gap heal; merge() dedups), everything in [first, mark] is
+        # skipped as already recorded.
+        self._first_op = None
         self._last_contiguous = 0
         if os.path.exists(path) and os.path.getsize(path):
             expect = None
             for m, _, _ in iter_entries(path):
                 op = m.header["op"]
                 if expect is None:
-                    # Anchor only at the true start of history: if the
-                    # first entry was lost to corruption, the mark must
-                    # stay 0 so WAL replay can backfill it (a later-op
-                    # anchor would wrongly mark the gap as recorded).
-                    if op > 1:
-                        break
+                    self._first_op = op
                 elif op != expect:
                     break
                 self._last_contiguous = op
@@ -65,7 +68,11 @@ class AOF:
         self._f = open(path, "ab")
 
     def append(self, prepare: Message, primary: int, replica: int) -> None:
-        if prepare.header["op"] <= self._last_contiguous:
+        op = prepare.header["op"]
+        if (
+            self._first_op is not None
+            and self._first_op <= op <= self._last_contiguous
+        ):
             return  # already durably recorded before a restart
         msg = prepare.to_bytes()
         entry = (

@@ -1437,11 +1437,18 @@ class Replica:
         else:
             results = b""  # register / root
 
-        # State hash chain: op + results (prepare checksums are excluded —
-        # re-proposed prepares legitimately differ across views).
+        # State hash per op: (op, committed BODY checksum, results). The
+        # body checksum is view-independent (re-proposed prepares reseal
+        # the header but not the body), so replicas committing DIFFERENT
+        # content at one op are caught even when both batches happen to
+        # produce identical result codes (e.g. two all-OK batches). Seal
+        # checksums stay excluded for exactly the re-proposal reason.
         self.commit_checksums[op_num] = hdr.checksum(
-            op_num.to_bytes(8, "little") + results
+            op_num.to_bytes(8, "little")
+            + int(h["checksum_body"]).to_bytes(16, "little")
+            + results
         )
+        self.last_committed_op = op_num
         self.on_event("commit", self)
 
         # Client-table update is replicated state: every replica applies it
