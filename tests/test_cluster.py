@@ -219,6 +219,22 @@ class TestReplicated:
         )
         assert cl.check_state_convergence() >= 6
 
+    def test_storage_convergence_at_checkpoint(self):
+        """Checkpoint artifacts are byte-identical across replicas
+        (reference storage_checker.zig — storage determinism enforced)."""
+        cl = Cluster(replica_count=3, seed=21)
+        c = setup_client(cl)
+        do_request(cl, c, Operation.CREATE_ACCOUNTS, account_batch([1, 2]))
+        # TEST_MIN checkpoints every 16 ops; drive well past one.
+        for i in range(20):
+            do_request(cl, c, Operation.CREATE_TRANSFERS, transfer_batch([
+                dict(id=1 + i, debit_account_id=1, credit_account_id=2,
+                     amount=1, ledger=1, code=1),
+            ]))
+        target = max(r.commit_min for r in cl.replicas)
+        cl.run_until(lambda: all(r.commit_min >= target for r in cl.replicas))
+        assert cl.check_storage_convergence() >= 16
+
     def test_determinism_same_seed(self):
         def run(seed):
             cl = Cluster(replica_count=3, seed=seed, loss=0.02)

@@ -154,12 +154,13 @@ class Simulator:
                 f"clients idle={[c.idle for c in cl.clients.values()]}"
             )
 
-        # Checks: auditor + state convergence + balances vs the oracle.
+        # Checks: auditor + state/storage convergence + balances vs oracle.
         if not self.workload.auditor.clean:
             for f in self.workload.auditor.failures[:5]:
                 print(f"correctness: {f}", file=sys.stderr)
             return EXIT_CORRECTNESS
         compared = cl.check_state_convergence()
+        cl.check_storage_convergence()
         orc = self.workload.auditor.oracle
         r0 = next(r for r in cl.replicas if r is not None)
         if r0.commit_min == self.workload.auditor._applied_op:

@@ -283,6 +283,47 @@ class Cluster:
 
     # --- checkers -------------------------------------------------------
 
+    def check_storage_convergence(self) -> int:
+        """Byte-compare the durable checkpoint artifacts across replicas
+        (reference storage_checker.zig: checkpointed on-disk bytes must be
+        identical — storage determinism is enforced, not assumed). Compares
+        the snapshot blob at the highest checkpoint op every live replica
+        has; returns the op compared, or 0 if no common checkpoint."""
+        live = [i for i, r in enumerate(self.replicas) if r is not None]
+        assert live
+        # Older checkpoints are pruned, so compare the replicas standing at
+        # the HIGHEST checkpoint op (>= 2 of them, else nothing to check).
+        ops = {i: self.replicas[i].superblock.state.op_checkpoint for i in live}
+        top = max(ops.values())
+        at_top = [i for i in live if ops[i] == top]
+        if top == 0 or len(at_top) < 2:
+            return 0
+        import io
+
+        # Client replies embed the RESPONDING replica's id in their sealed
+        # headers (reference: the client_replies zone is also per-replica),
+        # so those sections are compared per-field elsewhere; every other
+        # section — balances, indexes, manifests, log blocks, free set —
+        # must be byte-identical.
+        skip = {"client_table", "client_replies"}
+        sections = {}
+        for i in at_top:
+            blob = self.snapshots[i].load(top)
+            assert blob is not None, (
+                f"replica {i} advertises checkpoint {top} without a blob"
+            )
+            with np.load(io.BytesIO(blob)) as z:
+                sections[i] = {k: z[k] for k in z.files if k not in skip}
+        base_i = at_top[0]
+        for i in at_top[1:]:
+            assert sections[i].keys() == sections[base_i].keys()
+            for k, v in sections[base_i].items():
+                assert np.array_equal(sections[i][k], v), (
+                    f"storage divergence at checkpoint {top}: section {k!r} "
+                    f"differs between replicas {base_i} and {i}"
+                )
+        return top
+
     def check_state_convergence(self) -> int:
         """All replicas agree on commit checksums for every op all executed
         (reference state_checker.zig:94). Returns ops compared."""

@@ -179,6 +179,13 @@ class Replica:
         self.time = time if time is not None else DeterministicTime()
         self.clock = Clock(self.time, replica_count, replica_index)
 
+        # Timestamp high-water of COMMITTED prepares only: checkpoints must
+        # capture replicated state, and the primary's sm.prepare_timestamp
+        # runs ahead for in-flight (uncommitted) prepares — snapshotting it
+        # would make checkpoint bytes differ per replica (caught by the
+        # storage checker).
+        self.committed_timestamp_max = 0
+
         self.tick_count = 0
         self.last_heartbeat_tick = 0
         self.last_commit_sent_tick = 0
@@ -1448,6 +1455,9 @@ class Replica:
             + int(h["checksum_body"]).to_bytes(16, "little")
             + results
         )
+        self.committed_timestamp_max = max(
+            self.committed_timestamp_max, int(h["timestamp"])
+        )
         self.last_committed_op = op_num
         self.on_event("commit", self)
 
@@ -1526,7 +1536,7 @@ class Replica:
         st.commit_max = self.commit_max
         st.view = self.view
         st.log_view = self.log_view
-        st.prepare_timestamp = self.state_machine.prepare_timestamp
+        st.prepare_timestamp = self.committed_timestamp_max
         st.commit_timestamp = self.state_machine.commit_timestamp
         self.superblock.checkpoint()
         # The checkpoint is durable: staged grid frees (tables replaced by

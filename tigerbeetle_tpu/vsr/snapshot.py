@@ -138,7 +138,7 @@ def encode(replica, mode: str = "local") -> bytes:
             [sm.posted[k] for k in sorted(sm.posted.keys())], dtype=np.uint8
         ),
         history=history_to_array(sm.history),
-        prepare_timestamp=np.uint64(sm.prepare_timestamp),
+        prepare_timestamp=np.uint64(replica.committed_timestamp_max),
         commit_timestamp=np.uint64(sm.commit_timestamp),
         client_table=client_rows,
         client_replies=np.frombuffer(b"".join(reply_blobs), dtype=np.uint8),
@@ -296,6 +296,7 @@ def install(replica, blob: bytes) -> None:
     }
     sm.history = history_from_array(z["history"])
     sm.prepare_timestamp = int(z["prepare_timestamp"])
+    replica.committed_timestamp_max = int(z["prepare_timestamp"])
     sm.commit_timestamp = int(z["commit_timestamp"])
 
     replies = z["client_replies"].tobytes()
