@@ -300,12 +300,19 @@ class Cluster:
             return 0
         import io
 
-        # Client replies embed the RESPONDING replica's id in their sealed
-        # headers (reference: the client_replies zone is also per-replica),
-        # so those sections are compared per-field elsewhere; every other
-        # section — balances, indexes, manifests, log blocks, free set —
-        # must be byte-identical.
-        skip = {"client_table", "client_replies"}
+        # Excluded sections: client_replies embed the RESPONDING replica's
+        # id in their sealed headers (the reference's client_replies zone
+        # is also per-replica), and the grid-LAYOUT sections (block
+        # addresses in log_blocks/log_tail, manifests, free set) are
+        # per-replica once any replica state-synced — install() rebuilds
+        # its LSM one-shot, producing different block placement for
+        # identical logical content. Everything content-level — balances,
+        # account columns, posted, history, timestamps, and the replicated
+        # client TABLE rows (replica-independent) — must be byte-identical.
+        skip = {
+            "client_replies",
+            "log_blocks", "log_tail", "ti_manifest", "ai_manifest", "free_set",
+        }
         sections = {}
         for i in at_top:
             blob = self.snapshots[i].load(top)

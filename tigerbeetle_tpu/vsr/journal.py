@@ -28,6 +28,10 @@ class Journal:
         self.headers: Dict[int, Header] = {}  # slot -> prepare header
         self.dirty: set[int] = set()
         self.faulty: set[int] = set()
+        # Highest prepare timestamp ever journaled (incl. uncommitted):
+        # the primary's timestamp floor, so recovery/view-change can never
+        # assign a new prepare a timestamp at or below an in-flight one.
+        self.timestamp_max = 0
 
     def slot_for_op(self, op: int) -> int:
         return op % self.slot_count
@@ -69,6 +73,7 @@ class Journal:
         if sync:
             self.storage.sync()
         self.headers[slot] = message.header.copy()
+        self.timestamp_max = max(self.timestamp_max, int(message.header["timestamp"]))
         self.dirty.discard(slot)
         self.faulty.discard(slot)
 
@@ -109,6 +114,7 @@ class Journal:
         if sync:
             self.storage.sync()
         self.headers[slot] = header.copy()
+        self.timestamp_max = max(self.timestamp_max, int(header["timestamp"]))
         self.dirty.discard(slot)
         self.faulty.add(slot)
 
@@ -167,6 +173,7 @@ class Journal:
         self.headers = {}
         self.dirty = set()
         self.faulty = set()
+        self.timestamp_max = 0
         out: List[Header] = []
         for slot in range(self.slot_count):
             hraw = self.storage.read(
@@ -191,6 +198,7 @@ class Journal:
             )
             if header_ok and prepare_ok and rh["checksum"] == ph["checksum"]:
                 self.headers[slot] = rh
+                self.timestamp_max = max(self.timestamp_max, int(rh["timestamp"]))
                 out.append(rh)
             elif header_ok and prepare_ok:
                 # Both rings valid but disagree (journal.zig recovery cases
@@ -200,18 +208,22 @@ class Journal:
                 # the body must be repaired before use.
                 if ph["op"] > rh["op"]:
                     self.headers[slot] = ph
+                    self.timestamp_max = max(self.timestamp_max, int(ph["timestamp"]))
                     out.append(ph)
                     self.dirty.add(slot)  # header ring needs rewrite
                 else:
                     self.headers[slot] = rh
+                    self.timestamp_max = max(self.timestamp_max, int(rh["timestamp"]))
                     self.faulty.add(slot)
             elif header_ok:
                 # Redundant header says a prepare should be here: torn body.
                 self.headers[slot] = rh
+                self.timestamp_max = max(self.timestamp_max, int(rh["timestamp"]))
                 self.faulty.add(slot)
             elif prepare_ok:
                 # Body intact but header ring torn — body is authoritative.
                 self.headers[slot] = ph
+                self.timestamp_max = max(self.timestamp_max, int(ph["timestamp"]))
                 out.append(ph)
                 self.dirty.add(slot)  # header ring needs rewrite
         return out

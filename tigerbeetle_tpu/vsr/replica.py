@@ -502,7 +502,14 @@ class Replica:
             else 0
         )
         sm = self.state_machine
-        base = max(sm.prepare_timestamp, self._realtime_ns())
+        # journal.timestamp_max floors against in-flight (uncommitted)
+        # prepares adopted across a recovery/view change — a checkpoint
+        # records only the COMMITTED timestamp high-water, so without this
+        # floor a recovered primary could re-assign a timestamp already
+        # used by an op it later commits.
+        base = max(
+            sm.prepare_timestamp, self.journal.timestamp_max, self._realtime_ns()
+        )
         timestamp = base + n_events if n_events else base + 1
         sm.prepare_timestamp = timestamp
 
