@@ -23,12 +23,32 @@ log = logging.getLogger("tigerbeetle_tpu.bus")
 
 
 class _Conn:
+    # Bounded send queue (the reference's fixed message pool +
+    # connection_send_queue_max serve the same purpose, message_bus.zig):
+    # a stuck peer must exert backpressure, not grow our heap without
+    # bound. Dropping is safe — every VSR message is retried/re-derived.
+    SEND_BUFFER_MAX = 8 * (1 << 20)
+
     def __init__(self, writer: asyncio.StreamWriter) -> None:
         self.writer = writer
+        self.dropped = 0
 
     def send(self, data: bytes) -> None:
-        if not self.writer.is_closing():
-            self.writer.write(data)
+        if self.writer.is_closing():
+            return
+        transport = self.writer.transport
+        if (
+            transport is not None
+            and transport.get_write_buffer_size() + len(data) > self.SEND_BUFFER_MAX
+        ):
+            self.dropped += 1
+            if self.dropped == 1 or self.dropped % 1000 == 0:
+                log.warning(
+                    "send buffer full (peer stalled?): %d messages dropped "
+                    "on this connection", self.dropped,
+                )
+            return
+        self.writer.write(data)
 
 
 async def read_message(reader: asyncio.StreamReader) -> Optional[Message]:
