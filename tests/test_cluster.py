@@ -219,6 +219,39 @@ class TestReplicated:
         )
         assert cl.check_state_convergence() >= 6
 
+    def test_query_ops_through_vsr(self):
+        """get_account_transfers + get_account_history over the full
+        replicated path, byte-checked against the oracle's view."""
+        from tigerbeetle_tpu.flags import AccountFlags
+
+        cl = Cluster(replica_count=3, seed=31)
+        c = setup_client(cl)
+        do_request(
+            cl, c, Operation.CREATE_ACCOUNTS,
+            account_batch([1], flags=int(AccountFlags.HISTORY))
+        )
+        do_request(cl, c, Operation.CREATE_ACCOUNTS, account_batch([2]))
+        for i in range(5):
+            do_request(cl, c, Operation.CREATE_TRANSFERS, transfer_batch([
+                dict(id=1 + i, debit_account_id=1, credit_account_id=2,
+                     amount=10 * (i + 1), ledger=1, code=1),
+            ]))
+
+        f = np.zeros(1, dtype=types.ACCOUNT_FILTER_DTYPE)
+        f["account_id_lo"] = 1
+        f["limit"] = 10
+        f["flags"] = 0x3  # debits | credits
+        r = do_request(cl, c, Operation.GET_ACCOUNT_TRANSFERS, f.tobytes())
+        recs = np.frombuffer(bytearray(r.body), dtype=types.TRANSFER_DTYPE)
+        assert [types.u128_of(t, "amount") for t in recs] == [10, 20, 30, 40, 50]
+
+        r = do_request(cl, c, Operation.GET_ACCOUNT_HISTORY, f.tobytes())
+        rows = np.frombuffer(bytearray(r.body), dtype=types.ACCOUNT_BALANCE_DTYPE)
+        # Running debits_posted after each transfer: 10, 30, 60, 100, 150.
+        assert [types.u128_of(b, "debits_posted") for b in rows] == [
+            10, 30, 60, 100, 150
+        ]
+
     def test_storage_convergence_at_checkpoint(self):
         """Checkpoint artifacts are byte-identical across replicas
         (reference storage_checker.zig — storage determinism enforced)."""
