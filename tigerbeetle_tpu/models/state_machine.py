@@ -126,12 +126,14 @@ class StateMachine:
     """
 
     def __init__(
-        self, config: Config = PRODUCTION, backend: str = "jax", grid=None
+        self, config: Config = PRODUCTION, backend: str = "jax", grid=None,
+        mesh=None,
     ) -> None:
         from tigerbeetle_tpu.io.grid import MemGrid
 
         self.config = config
         self.backend = backend
+        self.mesh = mesh
         # The durable LSM tier (grid blocks + tables): replicas pass a grid
         # over their data file's grid zone; standalone use gets a lazy
         # in-memory grid with the same code path.
@@ -143,8 +145,15 @@ class StateMachine:
         if backend == "jax":
             from tigerbeetle_tpu.ops import commit as commit_ops
 
-            self._ops = commit_ops
-            self.state = commit_ops.init_state(a)
+            if mesh is not None:
+                # Multi-chip: the same dispatcher over slot-sharded state
+                # (parallel/sharded_ops.py adapter).
+                from tigerbeetle_tpu.parallel.sharded_ops import ShardedOps
+
+                self._ops = ShardedOps(mesh, a)
+            else:
+                self._ops = commit_ops
+            self.state = self._ops.init_state(a)
         else:  # pure-host backend: balances live in numpy mirrors
             self._ops = None
             self._host_bal = {
@@ -683,7 +692,7 @@ class StateMachine:
         chain_id_p[:n] = chain_id
 
         new_state, codes_dev, amounts_dev, dr_after, cr_after, bail = (
-            commit_exact.create_transfers_exact(
+            self._ops.create_transfers_exact(
                 self.state, b, host_code_p, pinfo, chain_id_p
             )
         )

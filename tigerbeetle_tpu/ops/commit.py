@@ -360,3 +360,12 @@ def read_balances(state: LedgerState, slots: jnp.ndarray):
         state.credits_pending[s],
         state.credits_posted[s],
     )
+
+
+def create_transfers_exact(state, b, host_code, pending, chain_id):
+    """Facade re-export so every ops backend (this module, ShardedOps)
+    exposes the same surface and the dispatcher never falls back silently.
+    Lazy import: commit_exact imports from this module."""
+    from tigerbeetle_tpu.ops import commit_exact
+
+    return commit_exact.create_transfers_exact(state, b, host_code, pending, chain_id)

@@ -1,0 +1,70 @@
+"""ShardedOps: the StateMachine's ops facade over a device mesh.
+
+Drop-in replacement for the `ops.commit` module interface the host
+StateMachine drives (models/state_machine.py `self._ops`): ledger state
+lives slot-sharded across a ('dp','shard') mesh (parallel/sharding.py),
+the fast and exact kernels run their shard_map variants, and the
+gather/scatter helpers ride XLA's GSPMD auto-partitioning. The dispatcher
+is unchanged — multi-chip is a constructor argument
+(`StateMachine(..., mesh=...)`), not a different code path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tigerbeetle_tpu.ops import commit as commit_ops
+from tigerbeetle_tpu.results import CreateTransferResult as TR
+from tigerbeetle_tpu.parallel import sharding
+
+
+class ShardedOps:
+    TransferBatch = commit_ops.TransferBatch
+
+    def __init__(self, mesh, accounts_max: int) -> None:
+        self.mesh = mesh
+        self.accounts_max = accounts_max
+        self._fast = sharding.make_sharded_commit(mesh, accounts_max)
+        self._exact = sharding.make_sharded_commit_exact(mesh, accounts_max)
+        self._dp = mesh.shape["dp"]
+
+    def init_state(self, accounts_max: int):
+        assert accounts_max == self.accounts_max
+        return sharding.init_sharded_state(accounts_max, self.mesh)
+
+    def create_transfers_fast(self, state, b, host_code):
+        # The fast step shards the batch over 'dp'; pad to a multiple.
+        n = b.flags.shape[0]
+        pad = (-n) % self._dp
+        if pad:
+            def p1(a, fill=0):
+                out = np.full((n + pad, *a.shape[1:]), fill, dtype=a.dtype)
+                out[:n] = a
+                return out
+
+            b = commit_ops.TransferBatch(*[p1(np.asarray(x)) for x in b])
+            # Same never-applied pad code as state_machine._device_batch.
+            hc = p1(np.asarray(host_code), fill=int(TR.ID_MUST_NOT_BE_ZERO))
+        else:
+            hc = host_code
+        new_state, codes, bail = self._fast(state, b, hc)
+        return new_state, codes[:n] if pad else codes, bail
+
+    def create_transfers_exact(self, state, b, host_code, pending, chain_id):
+        return self._exact(state, b, host_code, pending, chain_id)
+
+    def register_accounts(self, state, slots, ledger, flags, mask):
+        return sharding.register_accounts_sharded(
+            self.mesh, state, slots, ledger, flags, mask
+        )
+
+    # Gather/scatter helpers: the single-chip jitted fns compose with
+    # sharded inputs via GSPMD (cross-shard gathers lower to collectives).
+    def read_balances(self, state, slots):
+        return commit_ops.read_balances(state, slots)
+
+    def write_balances(self, state, slots, dp, dpo, cp, cpo):
+        new = commit_ops.write_balances(state, slots, dp, dpo, cp, cpo)
+        # Re-pin the canonical shardings (a scatter's output sharding can
+        # decay to replicated, which would silently densify every table).
+        return sharding._place(new, self.mesh)
