@@ -221,6 +221,15 @@ def _repl_execute(client, op: str, rest: str, types) -> None:
         print(f"{len(recs)} transfers")
         for r in recs[:10]:
             print({"id": types.u128_of(r, "id"), "amount": types.u128_of(r, "amount")})
+    elif op == "get_account_history":
+        rows = client.get_account_history(objs[0]["account_id"])
+        print(f"{len(rows)} balance rows")
+        for r in rows[:10]:
+            print({
+                "timestamp": int(r["timestamp"]),
+                "debits_posted": types.u128_of(r, "debits_posted"),
+                "credits_posted": types.u128_of(r, "credits_posted"),
+            })
     else:
         print(f"unknown operation: {op}")
 

@@ -42,7 +42,13 @@ class ShardedOps:
                 out[:n] = a
                 return out
 
-            b = commit_ops.TransferBatch(*[p1(np.asarray(x)) for x in b])
+            # Slot fields pad with the -1 sentinel (same convention as
+            # state_machine._device_batch) so padded rows can never alias
+            # account slot 0 under any slot-validity mask.
+            b = commit_ops.TransferBatch(*[
+                p1(np.asarray(x), fill=-1 if name in ("dr_slot", "cr_slot") else 0)
+                for name, x in zip(commit_ops.TransferBatch._fields, b)
+            ])
             # Same never-applied pad code as state_machine._device_batch.
             hc = p1(np.asarray(host_code), fill=int(TR.ID_MUST_NOT_BE_ZERO))
         else:
