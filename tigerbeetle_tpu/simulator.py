@@ -220,6 +220,13 @@ def main(argv=None) -> int:
     p.add_argument("--verbose", action="store_true")
     args = p.parse_args(argv)
     if args.sweep:
+        from tigerbeetle_tpu import tracer
+
+        # Coverage marks (reference testing/marks.zig): the sweep must
+        # actually EXERCISE the defended recovery paths, or green seeds
+        # prove nothing about them.
+        tracer.enable()
+        tracer.reset()
         failures = []
         for seed in range(args.sweep):
             rc = run_seed(seed, args.requests, args.verbose)
@@ -228,11 +235,24 @@ def main(argv=None) -> int:
                 print(f"seed {seed}: FAIL exit={rc}", file=sys.stderr)
         taxonomy = {EXIT_CORRECTNESS: "correctness", EXIT_LIVENESS: "liveness",
                     EXIT_CRASH: "crash"}
+        marks = {
+            k: v["count"] for k, v in tracer.snapshot().items()
+            if k.startswith("mark.")
+        }
         print(
             f"sweep {args.sweep} seeds: {args.sweep - len(failures)} pass, "
             f"{len(failures)} fail "
             f"{[(s, taxonomy[rc]) for s, rc in failures] if failures else ''}"
+            f" marks={marks}"
         )
+        if args.sweep >= 100:
+            for required in (
+                "mark.view_change_enter", "mark.wal_repair_request",
+                "mark.journal_slot_faulty",
+            ):
+                assert marks.get(required), (
+                    f"sweep never exercised {required} — schedules too tame"
+                )
         return EXIT_PASS if not failures else max(rc for _, rc in failures)
     if args.seed is None:
         p.error("seed or --sweep required")

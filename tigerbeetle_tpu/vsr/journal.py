@@ -117,6 +117,7 @@ class Journal:
         self.timestamp_max = max(self.timestamp_max, int(header["timestamp"]))
         self.dirty.discard(slot)
         self.faulty.add(slot)
+        tracer.count("mark.journal_slot_faulty")
 
     def truncate(self, op_max: int) -> None:
         """Drop every journal entry above op_max (view-change truncation of
@@ -174,6 +175,7 @@ class Journal:
         self.dirty = set()
         self.faulty = set()
         self.timestamp_max = 0
+        tracer.count("mark.journal_recover")
         out: List[Header] = []
         for slot in range(self.slot_count):
             hraw = self.storage.read(
@@ -215,11 +217,13 @@ class Journal:
                     self.headers[slot] = rh
                     self.timestamp_max = max(self.timestamp_max, int(rh["timestamp"]))
                     self.faulty.add(slot)
+                    tracer.count("mark.journal_slot_faulty")
             elif header_ok:
                 # Redundant header says a prepare should be here: torn body.
                 self.headers[slot] = rh
                 self.timestamp_max = max(self.timestamp_max, int(rh["timestamp"]))
                 self.faulty.add(slot)
+                tracer.count("mark.journal_slot_faulty")
             elif prepare_ok:
                 # Body intact but header ring torn — body is authoritative.
                 self.headers[slot] = ph

@@ -767,6 +767,8 @@ class Replica:
         for op in self.repair_target:
             if op > self.commit_min and not self._journal_has_target(op):
                 wants.add(op)
+        if wants:
+            tracer.count("mark.wal_repair_request")
         for want in sorted(wants)[:8]:
             rp = hdr.make(
                 Command.REQUEST_PREPARE, self.cluster,
@@ -1112,6 +1114,7 @@ class Replica:
         if self.status == STATUS_NORMAL:
             self.log_view = self.view
         log.info("replica %d: view_change -> view %d", self.replica, new_view)
+        tracer.count("mark.view_change_enter")
         self.status = STATUS_VIEW_CHANGE
         self.view = max(self.view, new_view)
         self.last_heartbeat_tick = self.tick_count
@@ -1558,4 +1561,5 @@ class Replica:
         return snapshot.encode(self)
 
     def _load_snapshot(self, blob: bytes) -> None:
+        tracer.count("mark.state_sync_install")
         snapshot.install(self, blob)
