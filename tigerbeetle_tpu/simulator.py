@@ -239,12 +239,6 @@ def main(argv=None) -> int:
             k: v["count"] for k, v in tracer.snapshot().items()
             if k.startswith("mark.")
         }
-        print(
-            f"sweep {args.sweep} seeds: {args.sweep - len(failures)} pass, "
-            f"{len(failures)} fail "
-            f"{[(s, taxonomy[rc]) for s, rc in failures] if failures else ''}"
-            f" marks={marks}"
-        )
         if args.sweep >= 100:
             missing = [
                 required
@@ -262,6 +256,12 @@ def main(argv=None) -> int:
                     "schedules too tame", file=sys.stderr,
                 )
                 failures.append((-1, EXIT_LIVENESS))
+        print(
+            f"sweep {args.sweep} seeds: {args.sweep - len(failures)} pass, "
+            f"{len(failures)} fail "
+            f"{[(s, taxonomy[rc]) for s, rc in failures] if failures else ''}"
+            f" marks={marks}"
+        )
         return EXIT_PASS if not failures else max(rc for _, rc in failures)
     if args.seed is None:
         p.error("seed or --sweep required")

@@ -526,9 +526,7 @@ class Replica:
         self.pipeline.append(entry)
         self.journal.write_prepare(prepare)
         entry.ok_from.add(self.replica)
-        for r in range(self.replica_count):
-            if r != self.replica:
-                self.bus.send_to_replica(r, prepare)
+        self._replicate_chain(prepare)
         self._check_pipeline_quorum()
 
     def _retry_pipeline(self) -> None:
@@ -605,13 +603,37 @@ class Replica:
             return
         if op != self.op + 1:
             # Gap: remember commit target; repair will fetch missing ops.
+            # Still forward down the chain (reference replicate() forwards
+            # on receipt): our gap must not starve downstream replicas of
+            # fresh prepares.
+            self._replicate_chain(msg)
             self.commit_max = max(self.commit_max, h["commit"])
             self._repair_gaps(target=op)
             return
         self.op = op
         self.journal.write_prepare(msg)
+        self._replicate_chain(msg)
         self._send_prepare_ok(h)
         self._commit_journal(h["commit"])
+
+    def _replicate_chain(self, prepare: Message) -> None:
+        """Forward a freshly-accepted prepare down the replication chain
+        (reference replicate, replica.zig:6068): the primary sends each
+        prepare ONCE to its ring successor and every backup forwards to
+        the next replica until the ring would wrap back to the primary —
+        primary egress is one copy per prepare instead of n-1. Chain-break
+        liveness: while an op is UNCOMMITTED the primary's pipeline retry
+        fan-out re-sends it directly to every replica whose prepare_ok is
+        missing; once quorum commits (and the pipeline entry pops), a
+        still-missing tail replica catches up via the commit heartbeat →
+        _repair_gaps → REQUEST_PREPARE path instead."""
+        if self.replica_count <= 1:
+            return
+        v = prepare.header["view"]
+        pos = (self.replica - self.primary_index(v)) % self.replica_count
+        if pos + 1 >= self.replica_count:
+            return  # chain tail: the next hop would be the primary
+        self.bus.send_to_replica((self.replica + 1) % self.replica_count, prepare)
 
     def _send_prepare_ok(self, prepare_header: Header) -> None:
         ok = hdr.make(
