@@ -316,9 +316,10 @@ def _staged_exact_inputs(mix: str, n_accounts: int, scan_iters: int):
     return b, host_code, pending, chain_id, precharge_dr, precharge_cr
 
 
-def bench_exact(mix: str):
-    """Configs 3/4: order-dependent workloads through the fixed-point sweep
-    kernel (ops/commit_exact.py), device-resident."""
+def exact_setup(mix: str, scan_len: int = 16):
+    """Shared staging for configs 3/4 (bench + profile_exact): registered
+    accounts, seeded balances, one staged batch, its SortPlan, and the
+    static trace flags. Returns everything device-placed."""
     import jax
     import jax.numpy as jnp
 
@@ -326,7 +327,6 @@ def bench_exact(mix: str):
     from tigerbeetle_tpu.ops import commit_exact
 
     n_accounts = N_ACCOUNTS
-    K = 16
     state = commit_ops.init_state(1 << 14)
     flags = np.zeros(n_accounts, dtype=np.uint32)
     if mix == "config4":
@@ -340,7 +340,7 @@ def bench_exact(mix: str):
         np.ones(n_accounts, dtype=bool),
     )
     b, host_code, pending, chain_id, pre_dr, pre_cr = _staged_exact_inputs(
-        mix, n_accounts, scan_iters=K * 8
+        mix, n_accounts, scan_iters=scan_len * 8
     )
     # Seed balances so balancing clamps/limits have room, and pre-charge the
     # fabricated pendings.
@@ -357,17 +357,41 @@ def bench_exact(mix: str):
         debits_posted=jnp.asarray(seed), credits_posted=jnp.asarray(seed),
         debits_pending=jnp.asarray(dp), credits_pending=jnp.asarray(cp),
     )
+    plan = commit_exact.build_sort_plan(
+        np.asarray(b.flags), np.asarray(b.dr_slot), np.asarray(b.cr_slot),
+        np.asarray(pending.dr_slot), np.asarray(pending.cr_slot),
+        np.asarray(chain_id), np.asarray(pending.group), 1 << 14,
+    )
+    has_pv = bool(np.any(pending.found))
+    has_chains = bool(np.any(chain_id != np.arange(len(chain_id))))
     b = jax.tree.map(jnp.asarray, b)
     pending = jax.tree.map(jnp.asarray, pending)
     host_code = jnp.asarray(host_code)
     chain_id = jnp.asarray(chain_id)
+    plan = jax.tree.map(jnp.asarray, plan)
+    return state, b, host_code, pending, chain_id, plan, has_pv, has_chains
+
+
+def bench_exact(mix: str):
+    """Configs 3/4: order-dependent workloads through the fixed-point sweep
+    kernel (ops/commit_exact.py), device-resident."""
+    import jax
+    import jax.numpy as jnp
+
+    from tigerbeetle_tpu.ops import commit_exact
+
+    K = 16
+    state, b, host_code, pending, chain_id, plan, has_pv, has_chains = exact_setup(
+        mix, scan_len=K
+    )
 
     @jax.jit
     def window(state):
         def body(st, _):
             st2, codes, amounts, dra, cra, bail = (
                 commit_exact.create_transfers_exact_impl(
-                    st, b, host_code, pending, chain_id
+                    st, b, host_code, pending, chain_id, plan,
+                    has_pv=has_pv, has_chains=has_chains,
                 )
             )
             return st2, ((codes == 0).sum(dtype=jnp.uint32), bail)
@@ -380,13 +404,17 @@ def bench_exact(mix: str):
     assert not bool(bail), f"{mix}: warmup bailed"
     windows = 4
     t0 = time.perf_counter()
-    total = 0
+    posteds, bails = [], []
     for _ in range(windows):
         st, posted, bail = window(st)
-        total += int(posted)
+        # Device scalars only — fetching them here would insert a tunnel
+        # round trip per window and measure the relay, not the chip.
+        posteds.append(posted)
+        bails.append(bail)
     jax.block_until_ready(st)
     elapsed = time.perf_counter() - t0
-    assert not bool(bail)
+    total = sum(int(p) for p in posteds)
+    assert not any(bool(b) for b in bails)
     batches = windows * K
     return {
         # posted counts OK outcomes only; events rate is the processing
@@ -395,7 +423,7 @@ def bench_exact(mix: str):
         "posted_per_s": round(total / elapsed, 1),
         "events_per_s": round(batches * BATCH / elapsed, 1),
         "batch_ms_avg": round(elapsed / batches * 1e3, 3),
-        "accounts": n_accounts,
+        "accounts": N_ACCOUNTS,
         "kernel": "exact_sweep",
     }
 

@@ -362,10 +362,15 @@ def read_balances(state: LedgerState, slots: jnp.ndarray):
     )
 
 
-def create_transfers_exact(state, b, host_code, pending, chain_id):
+def create_transfers_exact(
+    state, b, host_code, pending, chain_id, plan=None, has_pv=True, has_chains=True
+):
     """Facade re-export so every ops backend (this module, ShardedOps)
     exposes the same surface and the dispatcher never falls back silently.
     Lazy import: commit_exact imports from this module."""
     from tigerbeetle_tpu.ops import commit_exact
 
-    return commit_exact.create_transfers_exact(state, b, host_code, pending, chain_id)
+    return commit_exact.create_transfers_exact(
+        state, b, host_code, pending, chain_id, plan,
+        has_pv=has_pv, has_chains=has_chains,
+    )

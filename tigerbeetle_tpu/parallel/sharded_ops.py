@@ -26,6 +26,9 @@ class ShardedOps:
         self.accounts_max = accounts_max
         self._fast = sharding.make_sharded_commit(mesh, accounts_max)
         self._exact = sharding.make_sharded_commit_exact(mesh, accounts_max)
+        self._exact_plan = sharding.make_sharded_commit_exact(
+            mesh, accounts_max, with_plan=True
+        )
         self._dp = mesh.shape["dp"]
 
     def init_state(self, accounts_max: int):
@@ -56,8 +59,15 @@ class ShardedOps:
         new_state, codes, bail = self._fast(state, b, hc)
         return new_state, codes[:n] if pad else codes, bail
 
-    def create_transfers_exact(self, state, b, host_code, pending, chain_id):
-        return self._exact(state, b, host_code, pending, chain_id)
+    def create_transfers_exact(
+        self, state, b, host_code, pending, chain_id, plan=None,
+        has_pv=True, has_chains=True,
+    ):
+        # has_pv/has_chains are single-chip trace-skip optimizations; the
+        # sharded kernels are built once with the general (True) trace.
+        if plan is None:
+            return self._exact(state, b, host_code, pending, chain_id)
+        return self._exact_plan(state, b, host_code, pending, chain_id, plan)
 
     def register_accounts(self, state, slots, ledger, flags, mask):
         return sharding.register_accounts_sharded(

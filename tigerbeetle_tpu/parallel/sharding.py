@@ -158,7 +158,7 @@ def make_sharded_commit(mesh: Mesh, accounts_max: int):
     return jax.jit(sm)
 
 
-def make_sharded_commit_exact(mesh: Mesh, accounts_max: int):
+def make_sharded_commit_exact(mesh: Mesh, accounts_max: int, with_plan: bool = False):
     """Sharded variant of the exact fixed-point sweep kernel
     (ops/commit_exact.create_transfers_exact): balancing clamps, limit
     flags, linked chains, pending post/void over slot-sharded state.
@@ -187,7 +187,8 @@ def make_sharded_commit_exact(mesh: Mesh, accounts_max: int):
     n_shard = mesh.shape["shard"]
     assert accounts_max % n_shard == 0
 
-    def step(state, b, host_code, pending, chain_id):
+    def step(state, b, host_code, pending, chain_id, *plan_arg):
+        plan = plan_arg[0] if plan_arg else None
         rows = state.debits_pending.shape[0]
         assert rows == accounts_max // n_shard
         shard_ix = jax.lax.axis_index("shard").astype(jnp.int32)
@@ -248,18 +249,21 @@ def make_sharded_commit_exact(mesh: Mesh, accounts_max: int):
             ), over
 
         return commit_exact.create_transfers_exact_impl(
-            state, b, host_code, pending, chain_id,
+            state, b, host_code, pending, chain_id, plan,
             balance_read=balance_read, balance_apply=balance_apply,
         )
 
     obs_spec = Observed(*([P()] * 4))
     pending_spec = commit_exact.PendingInfo(*([P()] * 8))
+    in_specs = [state_specs(), TransferBatch(*([P()] * 10)), P(), pending_spec, P()]
+    if with_plan:
+        # Host-precomputed sort plan, replicated (the sweep is batch-global).
+        in_specs.append(commit_exact.SortPlan(*([P()] * 8)))
     sm = shard_map(
         step,
         mesh=mesh,
         # Batch inputs replicated: the sweep is batch-global (see above).
-        in_specs=(state_specs(), TransferBatch(*([P()] * 10)),
-                  P(), pending_spec, P()),
+        in_specs=tuple(in_specs),
         out_specs=(state_specs(), P(), P(), obs_spec, obs_spec, P()),
         check_vma=False,
     )
