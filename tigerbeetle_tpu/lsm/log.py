@@ -29,15 +29,24 @@ class DurableLog:
         self.dtype = dtype
         self.records_per_block = grid.payload_max // dtype.itemsize
         assert self.records_per_block > 0
-        self.blocks: List[int] = []  # full blocks, in row order
+        self.blocks: List[int] = []  # flushed blocks, in row order
+        # Full blocks not yet written to the grid: commit appends are pure
+        # RAM; grid IO happens on the compaction beat (flush_pending) or at
+        # checkpoint — the reference's object tree likewise defers block
+        # writes to compaction (groove.zig), keeping the commit path free
+        # of storage calls.
+        self._pending_blocks: List[np.ndarray] = []
         self._tail = np.zeros(self.records_per_block, dtype=dtype)
         self._tail_len = 0
         self.count = 0
 
     # --- write ----------------------------------------------------------
 
-    def append_batch(self, records: np.ndarray) -> np.ndarray:
-        """Append (k,) records; returns their row indices (u32)."""
+    def append_batch(self, records: np.ndarray, ts=None) -> np.ndarray:
+        """Append (k,) records; returns their row indices (u32). RAM-only:
+        call flush_pending() from the beat (or checkpoint) to emit blocks.
+        `ts` optionally overrides the timestamp column during the copy, so
+        callers need not pre-copy their arrays just to stamp them."""
         k = len(records)
         rows = np.arange(self.count, self.count + k, dtype=np.uint32)
         self.count += k
@@ -45,23 +54,38 @@ class DurableLog:
         rpb = self.records_per_block
         while off < k:
             take = min(k - off, rpb - self._tail_len)
-            self._tail[self._tail_len : self._tail_len + take] = records[off : off + take]
+            dst = slice(self._tail_len, self._tail_len + take)
+            self._tail[dst] = records[off : off + take]
+            if ts is not None:
+                self._tail["timestamp"][dst] = ts[off : off + take]
             self._tail_len += take
             off += take
             if self._tail_len == rpb:
-                self._flush_tail()
+                self._pending_blocks.append(self._tail.copy())
+                self._tail_len = 0
         return rows
 
-    def _flush_tail(self) -> None:
-        block = self.grid.write_block(self._tail.tobytes(), BLOCK_TYPE_LOG)
-        self.blocks.append(block)
-        self._tail_len = 0
+    def flush_pending(self, max_blocks: int | None = None) -> int:
+        """Write up to `max_blocks` pending full blocks to the grid (all of
+        them when None). Returns how many remain pending."""
+        n = len(self._pending_blocks) if max_blocks is None else min(
+            max_blocks, len(self._pending_blocks)
+        )
+        for i in range(n):
+            block = self.grid.write_block(
+                self._pending_blocks[i].tobytes(), BLOCK_TYPE_LOG
+            )
+            self.blocks.append(block)
+        del self._pending_blocks[:n]
+        return len(self._pending_blocks)
 
     # --- read -----------------------------------------------------------
 
     def _read_block(self, b: int) -> np.ndarray:
-        payload = self.grid.read_block(self.blocks[b])
-        return np.frombuffer(payload, dtype=self.dtype)
+        if b < len(self.blocks):
+            payload = self.grid.read_block(self.blocks[b])
+            return np.frombuffer(payload, dtype=self.dtype)
+        return self._pending_blocks[b - len(self.blocks)]
 
     def gather(self, rows: np.ndarray) -> np.ndarray:
         """Rows → records, preserving the order of `rows`."""
@@ -72,7 +96,7 @@ class DurableLog:
         rpb = self.records_per_block
         blk = rows // rpb
         off = rows % rpb
-        tail_base = len(self.blocks)
+        tail_base = len(self.blocks) + len(self._pending_blocks)
         in_tail = blk >= tail_base
         for b in np.unique(blk[~in_tail]):
             recs = self._read_block(int(b))
@@ -90,11 +114,12 @@ class DurableLog:
         if row_start >= row_end:
             return
         rpb = self.records_per_block
+        full = len(self.blocks) + len(self._pending_blocks)
         b0 = row_start // rpb
         b1 = (row_end - 1) // rpb
         for b in range(b0, b1 + 1):
             base = b * rpb
-            if b < len(self.blocks):
+            if b < full:
                 recs = self._read_block(b)
             else:
                 recs = self._tail[: self._tail_len]
@@ -114,7 +139,10 @@ class DurableLog:
     # --- checkpoint -----------------------------------------------------
 
     def checkpoint(self) -> Tuple[np.ndarray, np.ndarray]:
-        """(block index array u32, tail records) for the snapshot blob."""
+        """(block index array u32, tail records) for the snapshot blob.
+        Flushes every pending block first — checkpoint state references
+        grid blocks, not RAM."""
+        self.flush_pending()
         return (
             np.array(self.blocks, dtype=np.uint32),
             self._tail[: self._tail_len].copy(),
@@ -122,6 +150,7 @@ class DurableLog:
 
     def restore(self, blocks: np.ndarray, tail: np.ndarray) -> None:
         self.blocks = [int(b) for b in blocks]
+        self._pending_blocks = []
         self._tail_len = len(tail)
         self._tail[: self._tail_len] = tail
         self.count = len(self.blocks) * self.records_per_block + self._tail_len

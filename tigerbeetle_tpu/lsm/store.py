@@ -33,8 +33,35 @@ def pack_keys(lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
     return out
 
 
+_hostops_checked = False
+_hostops_lib = None
+
+
+def _hostops():
+    global _hostops_checked, _hostops_lib
+    if not _hostops_checked:
+        from tigerbeetle_tpu import native
+
+        _hostops_lib = native.hostops()
+        _hostops_checked = True
+    return _hostops_lib
+
+
 def sort_lo_major(keys: np.ndarray) -> np.ndarray:
     """Stable argsort by the lo column (ties keep insertion order)."""
+    lib = _hostops()
+    if lib is not None and len(keys) > 512:
+        import ctypes
+
+        lo = np.ascontiguousarray(keys["lo"])
+        out = np.empty(len(keys), dtype=np.uint32)
+        rc = lib.hostops_argsort_u64(
+            len(keys),
+            lo.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        )
+        if rc == 0:
+            return out
     return np.argsort(keys["lo"], kind="stable")
 
 
@@ -124,12 +151,171 @@ class U128Index:
         out = np.full(n, NOT_FOUND, dtype=np.uint32)
         if n == 0:
             return out
-        pending = np.ones(n, dtype=bool)
+        # Read-optimized: collapse everything into ONE sorted run first.
+        # Inserts are rare (account registration) while lookups run on
+        # every batch's prefetch — per-part search overhead dominates the
+        # one-off merge cost by orders of magnitude.
+        if len(self._runs) + len(self._mem) > 1 or self._mem:
+            if self._mem:
+                self._flush_memtable()
+            if len(self._runs) > 1:
+                self._merge_runs()
         for run_keys, run_vals in self._runs:
-            search_run(run_keys, run_vals, keys, out, pending)
-        for mem_keys, mem_vals in self._mem:
-            search_run(mem_keys, mem_vals, keys, out, pending)
+            search_run(run_keys, run_vals, keys, out, np.ones(n, dtype=bool))
         return out
 
     def contains_any(self, keys: np.ndarray) -> bool:
         return bool(np.any(self.lookup_batch(keys) != NOT_FOUND))
+
+
+class NativeU128Map:
+    """C open-addressing u128 → u32 map (csrc/hostops.c) with the same
+    batch API as U128Index. Preferred for the account id → slot index:
+    hash probes beat sorted-run binary search by ~10× on batch lookups
+    (numpy searchsorted is ~90 ns/element on commodity hosts)."""
+
+    def __init__(self, lib, cap_hint: int = 1 << 12) -> None:
+        self._lib = lib
+        self._h = lib.hostops_map_new(cap_hint)
+        assert self._h, "hostops_map_new failed"
+        self.count = 0
+
+    def __del__(self):  # noqa: D105
+        lib = getattr(self, "_lib", None)
+        if lib is not None and getattr(self, "_h", None):
+            lib.hostops_map_free(self._h)
+            self._h = None
+
+    @staticmethod
+    def _ptrs(keys: np.ndarray):
+        import ctypes
+
+        lo = np.ascontiguousarray(keys["lo"])
+        hi = np.ascontiguousarray(keys["hi"])
+        return (
+            lo.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            hi.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            lo,  # keep alive
+            hi,
+        )
+
+    def insert_batch(self, keys: np.ndarray, values: np.ndarray) -> None:
+        import ctypes
+
+        n = len(keys)
+        if n == 0:
+            return
+        vals = np.ascontiguousarray(values, dtype=np.uint32)
+        plo, phi, _a, _b = self._ptrs(keys)
+        self._lib.hostops_map_insert_batch(
+            self._h, n, plo, phi,
+            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        )
+        self.count = int(self._lib.hostops_map_len(self._h))
+
+    def lookup_batch(self, keys: np.ndarray) -> np.ndarray:
+        import ctypes
+
+        n = len(keys)
+        out = np.full(n, NOT_FOUND, dtype=np.uint32)
+        if n == 0:
+            return out
+        plo, phi, _a, _b = self._ptrs(keys)
+        self._lib.hostops_map_lookup_batch(
+            self._h, n, plo, phi,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        )
+        return out
+
+    def contains_any(self, keys: np.ndarray) -> bool:
+        n = len(keys)
+        if n == 0:
+            return False
+        plo, phi, _a, _b = self._ptrs(keys)
+        return bool(self._lib.hostops_map_contains_any(self._h, n, plo, phi))
+
+
+class Bloom:
+    """Vectorized Bloom filter over u128 keys (two derived probes per key).
+
+    Membership pre-filter for the transfer-id uniqueness check: without
+    it, every batch's duplicate-id check walks every LSM table
+    (contains_any), which grows with history. No false negatives by
+    construction — every stored key is added exactly once; false
+    positives (~2% at design fill with 8 bits/key) fall back to the real
+    index lookup for just the flagged keys.
+    """
+
+    def __init__(self, capacity_hint: int) -> None:
+        bits = 1 << max(16, int(np.ceil(np.log2(max(1, capacity_hint) * 8))))
+        self.words = np.zeros(bits >> 6, dtype=np.uint64)
+        self._mask = np.uint64(bits - 1)
+        self.count = 0
+
+    @staticmethod
+    def _hash2(lo: np.ndarray, hi: np.ndarray):
+        C1 = np.uint64(0xBF58476D1CE4E5B9)
+        C2 = np.uint64(0x94D049BB133111EB)
+        x = lo.astype(np.uint64) ^ (hi.astype(np.uint64) * C2)
+        x ^= x >> np.uint64(30)
+        x *= C1
+        x ^= x >> np.uint64(27)
+        x *= C2
+        h1 = x ^ (x >> np.uint64(31))
+        h2 = (h1 >> np.uint64(32)) | (h1 << np.uint64(32))
+        return h1, h2
+
+    def add(self, lo: np.ndarray, hi: np.ndarray) -> None:
+        lib = _hostops()
+        if lib is not None and len(lo) > 64:
+            import ctypes
+
+            u64p = ctypes.POINTER(ctypes.c_uint64)
+            l = np.ascontiguousarray(lo, dtype=np.uint64)
+            h = np.ascontiguousarray(hi, dtype=np.uint64)
+            lib.hostops_bloom_add(
+                self.words.ctypes.data_as(u64p), int(self._mask), len(l),
+                l.ctypes.data_as(u64p), h.ctypes.data_as(u64p),
+            )
+        else:
+            h1, h2 = self._hash2(lo, hi)
+            for h in (h1, h2):
+                b = h & self._mask
+                np.bitwise_or.at(
+                    self.words, (b >> np.uint64(6)).astype(np.int64),
+                    np.uint64(1) << (b & np.uint64(63)),
+                )
+        self.count += len(lo)
+
+    def maybe(self, lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
+        lib = _hostops()
+        if lib is not None and len(lo) > 64:
+            import ctypes
+
+            u64p = ctypes.POINTER(ctypes.c_uint64)
+            l = np.ascontiguousarray(lo, dtype=np.uint64)
+            h = np.ascontiguousarray(hi, dtype=np.uint64)
+            out = np.empty(len(l), dtype=np.uint8)
+            lib.hostops_bloom_maybe(
+                self.words.ctypes.data_as(u64p), int(self._mask), len(l),
+                l.ctypes.data_as(u64p), h.ctypes.data_as(u64p),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            )
+            return out.astype(bool)
+        h1, h2 = self._hash2(lo, hi)
+        out = np.ones(len(lo), dtype=bool)
+        for h in (h1, h2):
+            b = h & self._mask
+            w = self.words[(b >> np.uint64(6)).astype(np.int64)]
+            out &= (w >> (b & np.uint64(63))) & np.uint64(1) != 0
+        return out
+
+
+def make_u128_index(cap_hint: int = 1 << 12):
+    """Native hash map when the C shim builds, sorted-run numpy otherwise."""
+    from tigerbeetle_tpu import native
+
+    lib = native.hostops()
+    if lib is not None:
+        return NativeU128Map(lib, cap_hint)
+    return U128Index()

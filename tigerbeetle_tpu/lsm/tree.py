@@ -160,6 +160,7 @@ class DurableIndex:
         # levels[0] is newest-flush tables (append order = age order).
         self.levels: List[List[TableInfo]] = [[]]
         self.count = 0
+        self._job: Optional["_CompactionJob"] = None
 
     # --- geometry -------------------------------------------------------
 
@@ -187,6 +188,10 @@ class DurableIndex:
             self.flush_memtable()
 
     def flush_memtable(self) -> None:
+        """Write the memtable as one sorted level-0 table. Compaction is
+        NOT triggered here — it runs incrementally via compact_step (the
+        bar/beat pacing, compaction.zig:1-31), so a flush costs one table
+        build, never a level fold."""
         if self._mem_count == 0:
             return
         keys = np.concatenate([k for k, _ in self._mem])
@@ -196,7 +201,6 @@ class DurableIndex:
         self._mem_count = 0
         table = self._build_table(keys[order], vals[order])
         self.levels[0].append(table)
-        self._maybe_compact()
 
     def _build_table(self, keys: np.ndarray, vals: np.ndarray) -> TableInfo:
         """Write sorted entries as data blocks + one index block."""
@@ -261,26 +265,51 @@ class DurableIndex:
         self.grid.release(table.index_block)
 
     # --- compaction -----------------------------------------------------
+    #
+    # Incremental k-way leveled compaction (the reference's bar/beat
+    # pacing, compaction.zig:1-31 + k_way_merge.zig:8, re-shaped for
+    # batch-vectorized hosts): when a level exceeds the growth factor, a
+    # _CompactionJob captures its tables and merges ALL of them in ONE
+    # k-way streaming pass — killing the old pairwise fold's O(k²) write
+    # amplification — in bounded per-beat steps (compact_step), so a major
+    # merge never stalls the commit path. Reads keep using the captured
+    # input tables until the job installs its output atomically.
 
-    def _maybe_compact(self) -> None:
-        level = 0
-        while level < len(self.levels) and len(self.levels[level]) > self.growth:
-            tables = self.levels[level]
-            # Fold pairwise, oldest first (stability: older run = A side).
-            # A fold step may emit several key-ordered non-overlapping
-            # tables when the output outgrows one index block.
-            merged = [tables[0]]
-            for t in tables[1:]:
-                new = self._merge_tables(merged, [t])
-                for old in merged:
-                    self._release_table(old)
-                self._release_table(t)
-                merged = new
-            self.levels[level] = []
-            if level + 1 >= len(self.levels):
-                self.levels.append([])
-            self.levels[level + 1].extend(merged)
-            level += 1
+    def compact_step(self, quota_entries: int = 1 << 15) -> bool:
+        """One beat of compaction work (≤ ~quota_entries merged entries).
+        Returns True while more compaction work remains queued."""
+        if self._job is None:
+            for level, tables in enumerate(self.levels):
+                if len(tables) > self.growth:
+                    self._job = _CompactionJob(self, level, list(tables))
+                    break
+        if self._job is None:
+            return False
+        if self._job.step(quota_entries):
+            self._install_job()
+        return self._job is not None or any(
+            len(t) > self.growth for t in self.levels
+        )
+
+    def _install_job(self) -> None:
+        job = self._job
+        self._job = None
+        out = job.writer.finish()
+        captured = set(id(t) for t in job.tables)
+        self.levels[job.level] = [
+            t for t in self.levels[job.level] if id(t) not in captured
+        ]
+        if job.level + 1 >= len(self.levels):
+            self.levels.append([])
+        self.levels[job.level + 1].extend(out)
+        for t in job.tables:
+            self._release_table(t)
+
+    def drain_compaction(self) -> None:
+        """Run every queued compaction to completion (checkpoint barrier:
+        a manifest must never reference a half-written merge)."""
+        while self.compact_step(1 << 62):
+            pass
 
     def _merge_chunk(self, ka, va, kb, vb) -> Tuple[np.ndarray, np.ndarray]:
         from tigerbeetle_tpu.ops import merge as merge_ops
@@ -324,26 +353,37 @@ class DurableIndex:
         return out.finish()
 
     def compact_all(self) -> None:
-        """Forced major compaction: fold every level into one bottom run
-        (the reference's compaction-storm shape, BASELINE config 5 —
-        compaction.zig pacing collapsed into one synchronous pass)."""
+        """Forced major compaction: merge every level into one bottom run
+        (the reference's compaction-storm shape, BASELINE config 5).
+        Hierarchical k-way: groups of ≤16 streams per pass (bounded
+        buffered memory), so t tables cost ~log₁₆(t) passes instead of the
+        old pairwise fold's t passes."""
+        self.drain_compaction()
         self.flush_memtable()
         # Oldest-first: deeper levels hold older data; within a level,
-        # append order is age order. Stability keeps the older run on the
-        # A side of every fold.
+        # append order is age order. Group merges keep age order because
+        # groups are formed and concatenated in order and the chunk
+        # combine is stable.
         tables: List[TableInfo] = [
             t for level in reversed(self.levels) for t in level
         ]
-        if len(tables) <= 1:
-            return
-        merged = [tables[0]]
-        for t in tables[1:]:
-            new = self._merge_tables(merged, [t])
-            for old in merged:
-                self._release_table(old)
-            self._release_table(t)
-            merged = new
-        self.levels = [[], merged]
+        while len(tables) > 1:
+            one_group = len(tables) <= 16
+            next_round: List[TableInfo] = []
+            for g in range(0, len(tables), 16):
+                group = tables[g : g + 16]
+                if len(group) == 1:
+                    next_round.extend(group)
+                    continue
+                job = _CompactionJob(self, 0, group)
+                job.step(1 << 62)
+                next_round.extend(job.writer.finish())
+                for t in group:
+                    self._release_table(t)
+            tables = next_round
+            if one_group:
+                break  # a single merge's outputs are already disjoint
+        self.levels = [[], tables]
 
     # --- read path ------------------------------------------------------
 
@@ -440,7 +480,10 @@ class DurableIndex:
     # --- checkpoint -----------------------------------------------------
 
     def checkpoint(self) -> np.ndarray:
-        """Flush the memtable and return the manifest (MANIFEST_DTYPE rows)."""
+        """Flush the memtable and return the manifest (MANIFEST_DTYPE rows).
+        Drains any in-flight compaction first: a manifest must never
+        reference a half-written merge's inputs-and-orphaned-outputs."""
+        self.drain_compaction()
         self.flush_memtable()
         rows = []
         for level, tables in enumerate(self.levels):
@@ -456,6 +499,7 @@ class DurableIndex:
         self._mem_count = 0
         self.levels = [[]]
         self.count = 0
+        self._job = None
         for rec in manifest:
             level = int(rec["level"])
             while level >= len(self.levels):
@@ -468,6 +512,66 @@ class DurableIndex:
             )
             self.levels[level].append(t)
             self.count += t.count
+
+
+class _CompactionJob:
+    """Resumable k-way merge of a captured table list into one key-ordered
+    output run (k_way_merge.zig:8's role). Work is metered in entries per
+    `step` call; between steps the tree keeps serving reads from the input
+    tables. The chunk combine is stable with streams ordered oldest-first,
+    preserving the age precedence the lookup path relies on."""
+
+    def __init__(self, tree: DurableIndex, level: int, tables: List[TableInfo]) -> None:
+        self.tree = tree
+        self.level = level
+        self.tables = tables
+        self.streams = [_MergeStream(tree, [t]) for t in tables]
+        self.writer = _TableWriter(tree)
+
+    def step(self, quota_entries: int) -> bool:
+        """Merge ≥1 chunk, up to ~quota_entries; True when exhausted."""
+        merged = 0
+        while merged < quota_entries:
+            live = [s for s in self.streams if not s.exhausted()]
+            if not live:
+                return True
+            if len(live) == 1:
+                k, v = live[0].take(None)
+                self.writer.append(k, v)
+                merged += len(k)
+                continue
+            # Everything at or below the smallest buffered tail key can be
+            # ordered now — later input in any stream sorts past it.
+            bound = min(s.last_buffered_lo() for s in live)
+            parts_k, parts_v = [], []
+            for s in live:  # oldest-first order
+                k, v = s.take(bound)
+                if len(k):
+                    parts_k.append(k)
+                    parts_v.append(v)
+            ck, cv = self._combine(parts_k, parts_v)
+            self.writer.append(ck, cv)
+            merged += len(ck)
+        return False
+
+    def _combine(
+        self, parts_k: List[np.ndarray], parts_v: List[np.ndarray]
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        if len(parts_k) == 1:
+            return parts_k[0], parts_v[0]
+        if self.tree.backend == "jax":
+            # Chip-colocated hosts fold the chunk through the device
+            # merge-path kernel (ops/merge.py) pairwise — each part is
+            # sorted, and the fold keeps older parts on the A side.
+            mk, mv = parts_k[0], parts_v[0]
+            for k, v in zip(parts_k[1:], parts_v[1:]):
+                mk, mv = self.tree._merge_chunk(mk, mv, k, v)
+            return mk, mv
+        # Host path: concatenate oldest-first + stable radix argsort.
+        k = np.concatenate(parts_k)
+        v = np.concatenate(parts_v)
+        order = sort_lo_major(k)
+        return k[order], v[order]
 
 
 class _TableWriter:

@@ -31,7 +31,9 @@ from tigerbeetle_tpu.flags import AccountFlags, TransferFlags
 from tigerbeetle_tpu.lsm.store import (
     KEY_DTYPE,
     NOT_FOUND,
+    Bloom,
     U128Index,
+    make_u128_index,
     pack_keys,
     search_run,
     sort_lo_major,
@@ -116,6 +118,35 @@ def _codes_to_results(codes: np.ndarray) -> np.ndarray:
     return out
 
 
+def _batch_has_dup(events: np.ndarray) -> bool:
+    """Any duplicate transfer id within the batch? C hash probe when the
+    shim is available (~10× the lexsort-adjacency check), else numpy."""
+    from tigerbeetle_tpu.lsm.store import _hostops
+
+    lib = _hostops()
+    n = len(events)
+    if lib is not None:
+        import ctypes
+
+        lo = np.ascontiguousarray(events["id_lo"])
+        hi = np.ascontiguousarray(events["id_hi"])
+        rc = lib.hostops_batch_has_dup(
+            n,
+            lo.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            hi.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        )
+        # rc < 0 = scratch allocation failure: claim "duplicate" so the
+        # dispatcher takes the serial path, which handles dups correctly.
+        return rc != 0
+    keys = pack_keys(events["id_lo"], events["id_hi"])
+    # lo-major sort with hi tiebreak: equal-lo duplicates must land
+    # adjacent (a lo-only stable sort would leave (hi=1,lo=5),(hi=2,lo=5),
+    # (hi=1,lo=5) non-adjacent).
+    sk = keys[np.lexsort((keys["hi"], keys["lo"]))]
+    adj = sk["lo"][1:] == sk["lo"][:-1]
+    return bool(np.any(adj & (sk["hi"][1:] == sk["hi"][:-1])))
+
+
 class StateMachine:
     """Single-replica accounting state machine (device-accelerated).
 
@@ -183,7 +214,7 @@ class StateMachine:
         # the transfer id index, account secondary index, and the object log
         # live on the grid (reference groove.zig: id tree + indexes + object
         # tree).
-        self.account_index = U128Index()
+        self.account_index = make_u128_index(config.accounts_max)
         self.transfer_index = DurableIndex(
             self.grid, unique=True,
             memtable_max=config.index_memtable_rows, backend=backend,
@@ -193,6 +224,9 @@ class StateMachine:
             memtable_max=config.index_memtable_rows, backend=backend,
         )
         self.transfer_log = DurableLog(self.grid, types.TRANSFER_DTYPE)
+        # Transfer-id membership pre-filter (no false negatives): keeps the
+        # per-batch duplicate-id check O(batch) instead of O(tables).
+        self.transfer_seen = Bloom(config.transfers_max)
         # pending-transfer timestamp → fulfillment (reference PostedGroove).
         self.posted: Dict[int, int] = {}
         self.history: List[oracle_mod.HistoryRow] = []
@@ -206,19 +240,25 @@ class StateMachine:
             "serial_batches": 0, "bail_batches": 0,
         }
 
-    def _store_new_transfers(self, recs: np.ndarray) -> None:
+    def _store_new_transfers(self, recs: np.ndarray, ts=None) -> None:
         """Append committed transfers to the object log and both indexes
         (reference groove insert: object tree + id tree + secondary
-        indexes, groove.zig:138)."""
-        rows = self.transfer_log.append_batch(recs)
-        self.transfer_index.insert_batch(
-            pack_keys(recs["id_lo"], recs["id_hi"]), rows
-        )
-        acct_keys = np.concatenate([
-            pack_keys(recs["debit_account_id_lo"], recs["debit_account_id_hi"]),
-            pack_keys(recs["credit_account_id_lo"], recs["credit_account_id_hi"]),
-        ])
-        self.account_rows.insert_batch(acct_keys, np.concatenate([rows, rows]))
+        indexes, groove.zig:138). `ts` optionally overrides the stored
+        timestamp column during the log's copy (zero-copy path: the
+        caller's event array is not mutated)."""
+        with tracer.span("sm.store.log"):
+            rows = self.transfer_log.append_batch(recs, ts=ts)
+            self.transfer_seen.add(recs["id_lo"], recs["id_hi"])
+        with tracer.span("sm.store.idx"):
+            self.transfer_index.insert_batch(
+                pack_keys(recs["id_lo"], recs["id_hi"]), rows
+            )
+        with tracer.span("sm.store.rows"):
+            acct_keys = np.concatenate([
+                pack_keys(recs["debit_account_id_lo"], recs["debit_account_id_hi"]),
+                pack_keys(recs["credit_account_id_lo"], recs["credit_account_id_hi"]),
+            ])
+            self.account_rows.insert_batch(acct_keys, np.concatenate([rows, rows]))
 
     # ------------------------------------------------------------------
     # prepare (timestamp assignment, reference state_machine.zig:503-511)
@@ -227,6 +267,22 @@ class StateMachine:
         if operation in ("create_accounts", "create_transfers"):
             self.prepare_timestamp += event_count
         return self.prepare_timestamp
+
+    # ------------------------------------------------------------------
+    # compaction beat (reference forest.compact, forest.zig:319): bounded
+    # background storage work interleaved between commits, so the commit →
+    # reply path itself performs no grid IO.
+
+    def compact_beat(self, max_blocks: int = 8) -> None:
+        """One beat of deferred storage work: flush up to `max_blocks` of
+        the object log's pending blocks and run one bounded compaction
+        step on each durable index. Driven once per committed op from
+        inside the commit apply path — WAL replay re-runs the identical
+        beat sequence, so grid allocation order (and therefore checkpoint
+        bytes) stays deterministic across replicas and restarts."""
+        self.transfer_log.flush_pending(max_blocks)
+        self.transfer_index.compact_step()
+        self.account_rows.compact_step()
 
     # ------------------------------------------------------------------
     # balances access (device or host backend)
@@ -399,18 +455,20 @@ class StateMachine:
         # within the batch, ids already stored, or a post/void whose
         # pending_id is an id created in this batch.
         hard = False
-        sorted_ids = keys
-        if n > 1:
-            # lo-major sort with hi tiebreak: equal-lo duplicates must land
-            # adjacent for the duplicate check (a lo-only stable sort would
-            # leave (hi=1,lo=5),(hi=2,lo=5),(hi=1,lo=5) non-adjacent).
-            sorted_ids = keys[np.lexsort((keys["hi"], keys["lo"]))]
-            adj = sorted_ids["lo"][1:] == sorted_ids["lo"][:-1]
-            hard = bool(np.any(adj & (sorted_ids["hi"][1:] == sorted_ids["hi"][:-1])))
-        if not hard:
-            hard = self.transfer_index.contains_any(keys)
+        with tracer.span("sm.ct.dupcheck"):
+            if n > 1:
+                hard = _batch_has_dup(events)
+            if not hard and self.transfer_seen.count:
+                # Bloom pre-filter: only keys the filter flags (stored ids
+                # plus ~2% false positives) hit the real index.
+                maybe = self.transfer_seen.maybe(events["id_lo"], events["id_hi"])
+                if maybe.any():
+                    hard = self.transfer_index.contains_any(keys[maybe])
         pv_keys = None
         if not hard and bool(np.any(is_pv)):
+            # lo-major sort with hi tiebreak so the in-batch pending_id
+            # probe below sees equal-lo keys adjacent.
+            sorted_ids = keys[np.lexsort((keys["hi"], keys["lo"]))]
             pv_keys = pack_keys(
                 events["pending_id_lo"][is_pv], events["pending_id_hi"][is_pv]
             )
@@ -425,12 +483,14 @@ class StateMachine:
             with tracer.span("sm.create_transfers.serial"):
                 return self._create_transfers_serial(events, timestamp)
 
-        dr_keys = pack_keys(events["debit_account_id_lo"], events["debit_account_id_hi"])
-        cr_keys = pack_keys(events["credit_account_id_lo"], events["credit_account_id_hi"])
-        dr_slots = self.account_index.lookup_batch(dr_keys).astype(np.int64)
-        cr_slots = self.account_index.lookup_batch(cr_keys).astype(np.int64)
-        dr_slots[dr_slots == int(NOT_FOUND)] = -1
-        cr_slots[cr_slots == int(NOT_FOUND)] = -1
+        with tracer.span("sm.ct.slots"):
+            both_keys = np.concatenate([
+                pack_keys(events["debit_account_id_lo"], events["debit_account_id_hi"]),
+                pack_keys(events["credit_account_id_lo"], events["credit_account_id_hi"]),
+            ])
+            both_slots = self.account_index.lookup_batch(both_keys).astype(np.int64)
+            both_slots[both_slots == int(NOT_FOUND)] = -1
+            dr_slots, cr_slots = both_slots[:n], both_slots[n:]
 
         # Order-dependent batches (balancing clamps, limit/history accounts)
         # run the fixed-point exact kernel; the rest the cheaper simple one.
@@ -814,25 +874,33 @@ class StateMachine:
         from tigerbeetle_tpu.models import host_kernel
 
         timestamp = int(ts[-1])
-        codes = host_kernel.validate(
-            events, ts, dr_slots, cr_slots, self.acc_ledger, host_code
-        )
+        with tracer.span("sm.ct.validate"):
+            codes = host_kernel.validate(
+                events, ts, dr_slots, cr_slots, self.acc_ledger, host_code
+            )
         ok = codes == 0
         pend = (events["flags"].astype(np.uint32) & np.uint32(TransferFlags.PENDING)) != 0
-        overflow = host_kernel.post(
-            self._host_bal,
-            dr_slots, cr_slots,
-            events["amount_lo"].astype(np.uint64), events["amount_hi"].astype(np.uint64),
-            ok & pend, ok & ~pend,
-        )
+        with tracer.span("sm.ct.post"):
+            overflow = host_kernel.post(
+                self._host_bal,
+                dr_slots, cr_slots,
+                events["amount_lo"].astype(np.uint64), events["amount_hi"].astype(np.uint64),
+                ok & pend, ok & ~pend,
+            )
         if overflow:
             self.stats["bail_batches"] += 1
             return self._create_transfers_serial(events, timestamp)
         self.stats["fast_batches"] += 1
         if np.any(ok):
-            recs = events[ok].copy()
-            recs["timestamp"] = ts[ok]
-            self._store_new_transfers(recs)
+            with tracer.span("sm.ct.store"):
+                if ok.all():
+                    # Zero-copy: the log's append stamps timestamps during
+                    # its own copy; `events` is never mutated.
+                    self._store_new_transfers(events, ts=ts)
+                else:
+                    recs = events[ok].copy()
+                    recs["timestamp"] = ts[ok]
+                    self._store_new_transfers(recs)
             self.commit_timestamp = int(ts[ok][-1])
         return _codes_to_results(codes)
 

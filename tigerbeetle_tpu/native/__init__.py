@@ -22,14 +22,96 @@ import os
 import subprocess
 from typing import Callable, Optional
 
-_SRC = os.path.join(
+_CSRC = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-    "csrc", "aegis128l.c",
+    "csrc",
 )
-_LIB = os.path.join(os.path.dirname(_SRC), "libaegis128l.so")
+_SRC = os.path.join(_CSRC, "aegis128l.c")
+_LIB = os.path.join(_CSRC, "libaegis128l.so")
 
 _mac: Optional[Callable[[bytes], bytes]] = None
 _tried = False
+
+
+def _build_lib(src: str, lib: str, extra_flags: tuple = ()) -> bool:
+    """Compile `src` → shared object `lib` if stale; True on success."""
+    if os.path.exists(lib) and os.path.getmtime(lib) >= os.path.getmtime(src):
+        return True
+    tmp = f"{lib}.{os.getpid()}.tmp"  # pid-unique: concurrent first builds
+    # must not interleave into one output (os.replace is atomic)
+    for cc in ("cc", "gcc", "clang"):
+        try:
+            r = subprocess.run(
+                [cc, "-O3", *extra_flags, "-shared", "-fPIC", src, "-o", tmp],
+                capture_output=True, timeout=60,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if r.returncode == 0:
+            os.replace(tmp, lib)
+            return True
+    try:
+        os.unlink(tmp)
+    except OSError:
+        pass
+    return False
+
+
+_hostops: Optional[ctypes.CDLL] = None
+_hostops_tried = False
+
+
+def hostops() -> Optional[ctypes.CDLL]:
+    """Batch host primitives (csrc/hostops.c): u128 hash map, radix
+    argsort, exact u128 posting. Plain C — any host with a compiler."""
+    global _hostops, _hostops_tried
+    if _hostops_tried:
+        return _hostops
+    _hostops_tried = True
+    src = os.path.join(_CSRC, "hostops.c")
+    lib_path = os.path.join(_CSRC, "libhostops.so")
+    if not os.path.exists(src) or not _build_lib(src, lib_path):
+        return None
+    try:
+        lib = ctypes.CDLL(lib_path)
+    except OSError:
+        return None
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.hostops_map_new.argtypes = [ctypes.c_uint64]
+    lib.hostops_map_new.restype = ctypes.c_void_p
+    lib.hostops_map_free.argtypes = [ctypes.c_void_p]
+    lib.hostops_map_len.argtypes = [ctypes.c_void_p]
+    lib.hostops_map_len.restype = ctypes.c_uint64
+    lib.hostops_map_insert_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, u64p, u64p, u32p,
+    ]
+    lib.hostops_map_lookup_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, u64p, u64p, u32p,
+    ]
+    lib.hostops_map_contains_any.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, u64p, u64p,
+    ]
+    lib.hostops_map_contains_any.restype = ctypes.c_int
+    lib.hostops_batch_has_dup.argtypes = [ctypes.c_int64, u64p, u64p]
+    lib.hostops_batch_has_dup.restype = ctypes.c_int
+    lib.hostops_argsort_u64.argtypes = [ctypes.c_int64, u64p, u32p]
+    lib.hostops_argsort_u64.restype = ctypes.c_int
+    lib.hostops_bloom_add.argtypes = [
+        u64p, ctypes.c_uint64, ctypes.c_int64, u64p, u64p,
+    ]
+    lib.hostops_bloom_maybe.argtypes = [
+        u64p, ctypes.c_uint64, ctypes.c_int64, u64p, u64p, u8p,
+    ]
+    lib.hostops_post_u128.argtypes = [
+        u32p, u32p, u32p, u32p, ctypes.c_int64,
+        i64p, i64p, u64p, u64p, u8p, u8p,
+    ]
+    lib.hostops_post_u128.restype = ctypes.c_int
+    _hostops = lib
+    return _hostops
 
 
 def _cpu_has_aes() -> bool:
@@ -47,27 +129,7 @@ def _cpu_has_aes() -> bool:
 
 
 def _build() -> bool:
-    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
-        return True
-    tmp = f"{_LIB}.{os.getpid()}.tmp"  # pid-unique: concurrent first
-    # builds must not interleave into one output (os.replace is atomic)
-    for cc in ("cc", "gcc", "clang"):
-        try:
-            r = subprocess.run(
-                [cc, "-O3", "-maes", "-mssse3", "-shared", "-fPIC",
-                 _SRC, "-o", tmp],
-                capture_output=True, timeout=60,
-            )
-        except (OSError, subprocess.TimeoutExpired):
-            continue
-        if r.returncode == 0:
-            os.replace(tmp, _LIB)
-            return True
-    try:
-        os.unlink(tmp)
-    except OSError:
-        pass
-    return False
+    return _build_lib(_SRC, _LIB, extra_flags=("-maes", "-mssse3"))
 
 
 def aegis128l_mac() -> Optional[Callable[[bytes], bytes]]:

@@ -17,6 +17,7 @@ import logging
 import traceback
 from typing import Dict, List, Optional, Tuple
 
+from tigerbeetle_tpu import tracer
 from tigerbeetle_tpu.vsr.header import HEADER_SIZE, Command, Header, Message
 
 log = logging.getLogger("tigerbeetle_tpu.bus")
@@ -33,13 +34,15 @@ class _Conn:
         self.writer = writer
         self.dropped = 0
 
-    def send(self, data: bytes) -> None:
+    def _can_send(self, size: int) -> bool:
+        """Backpressure guard: drop (and count) when the peer's send
+        buffer is full — every VSR message is retried/re-derived."""
         if self.writer.is_closing():
-            return
+            return False
         transport = self.writer.transport
         if (
             transport is not None
-            and transport.get_write_buffer_size() + len(data) > self.SEND_BUFFER_MAX
+            and transport.get_write_buffer_size() + size > self.SEND_BUFFER_MAX
         ):
             self.dropped += 1
             if self.dropped == 1 or self.dropped % 1000 == 0:
@@ -47,8 +50,20 @@ class _Conn:
                     "send buffer full (peer stalled?): %d messages dropped "
                     "on this connection", self.dropped,
                 )
-            return
-        self.writer.write(data)
+            return False
+        return True
+
+    def send(self, data: bytes) -> None:
+        if self._can_send(len(data)):
+            self.writer.write(data)
+
+    def send_message(self, msg: Message) -> None:
+        """Frame a message without concatenating header+body (a ~1 MiB
+        copy per prepare on the old path)."""
+        if self._can_send(HEADER_SIZE + len(msg.body)):
+            self.writer.write(msg.header.to_bytes())
+            if msg.body:
+                self.writer.write(msg.body)
 
 
 async def read_message(reader: asyncio.StreamReader) -> Optional[Message]:
@@ -69,9 +84,9 @@ async def read_message(reader: asyncio.StreamReader) -> Optional[Message]:
         except (asyncio.IncompleteReadError, ConnectionError):
             return None
     msg = Message(h, body)
-    if not h.valid_checksum_body(body):
-        return None
-    return msg
+    with tracer.span("bus.verify_body"):
+        ok = h.valid_checksum_body(body)
+    return msg if ok else None
 
 
 class ReplicaServer:
@@ -97,7 +112,7 @@ class ReplicaServer:
             return
         conn = self.peer_conns.get(r)
         if conn is not None:
-            conn.send(msg.to_bytes())
+            conn.send_message(msg)
 
     def _dispatch(self, msg: Message) -> None:
         """Fail-stop on replica exceptions (the reference's assert-and-crash
@@ -105,7 +120,8 @@ class ReplicaServer:
         makes a restart consistent, whereas a silently dead connection
         handler leaves a wedged zombie."""
         try:
-            self.replica.on_message(msg)
+            with tracer.span("bus.dispatch"):
+                self.replica.on_message(msg)
         except Exception:
             log.error(
                 "replica raised during on_message — failing stop:\n%s",
@@ -117,7 +133,7 @@ class ReplicaServer:
     def send_to_client(self, client_id: int, msg: Message) -> None:
         conn = self.client_conns.get(client_id)
         if conn is not None:
-            conn.send(msg.to_bytes())
+            conn.send_message(msg)
 
     # --- lifecycle ------------------------------------------------------
 

@@ -235,6 +235,16 @@ class Message:
         self.header.set_checksum()
         return self
 
+    def seal_with_body_checksum(self, checksum_body: int) -> "Message":
+        """Seal reusing an already-verified body checksum (checksum once:
+        a primary re-framing a client request into a prepare keeps the
+        body bytes — recomputing the 1 MiB body MAC would be pure waste;
+        the bus verified it on ingress)."""
+        self.header["size"] = HEADER_SIZE + len(self.body)
+        self.header["checksum_body"] = checksum_body
+        self.header.set_checksum()
+        return self
+
     def to_bytes(self) -> bytes:
         return self.header.to_bytes() + self.body
 

@@ -200,6 +200,14 @@ class Replica:
         self.commit_checksums: Dict[int, int] = {}
         self.checksum_floor = 0
 
+        # Optional WAL group-fsync batcher (vsr/journal.GroupSync): when
+        # set, prepare acks (self prepare_ok / backup PREPARE_OK) are
+        # deferred until the batched fdatasync lands — durability-before-ack
+        # is preserved while one fsync amortizes over every prepare in the
+        # batch. None = synchronous fsync per prepare (tests, simulator:
+        # deterministic single-thread semantics).
+        self.wal_group = None
+
     # ------------------------------------------------------------------
 
     @property
@@ -521,13 +529,55 @@ class Replica:
             operation=rh["operation"], client=rh["client"], request=rh["request"],
             parent=(prev["checksum"] if prev is not None else 0),
         )
-        prepare = Message(ph, request.body).seal()
+        # Checksum once: the request body was MAC-verified on ingress and is
+        # reused byte-for-byte as the prepare body.
+        prepare = Message(ph, request.body).seal_with_body_checksum(
+            request.header["checksum_body"]
+        )
         entry = Pipeline(prepare)
         self.pipeline.append(entry)
-        self.journal.write_prepare(prepare)
-        entry.ok_from.add(self.replica)
-        self._replicate_chain(prepare)
+        if self.wal_group is None:
+            self.journal.write_prepare(prepare)
+            entry.ok_from.add(self.replica)
+            self._replicate_chain(prepare)
+            self._check_pipeline_quorum()
+        else:
+            # Async WAL: buffer the write (page cache), replicate NOW so the
+            # network overlaps the fsync (reference replica.zig:3034 starts
+            # replication before its WAL write completes), and grant our own
+            # prepare_ok only once the group fsync lands (ack-after-durable).
+            self.journal.write_prepare(prepare, sync=False)
+            self._replicate_chain(prepare)
+            op, cks, view = self.op, ph["checksum"], self.view
+            self.wal_group.request(lambda: self._on_wal_durable(op, cks, view))
+
+    def _on_wal_durable(self, op: int, checksum: int, view: int) -> None:
+        """Group-fsync landed for our own prepare at `op`: grant the
+        primary's self prepare_ok (the durable half of the ack). Stale
+        callbacks — the view moved on, or the entry was re-proposed with a
+        different seal — are dropped, mirroring on_prepare_ok's guards:
+        committing in a view that has moved on could apply an op the new
+        view never chose."""
+        if (
+            self.status != STATUS_NORMAL
+            or not self.is_primary
+            or view != self.view
+        ):
+            return
+        for entry in self.pipeline:
+            h = entry.message.header
+            if h["op"] == op and h["checksum"] == checksum:
+                entry.ok_from.add(self.replica)
+                break
         self._check_pipeline_quorum()
+
+    def _backup_wal_durable(self, h: Header) -> None:
+        """Group-fsync landed for a backup's accepted prepare: send the
+        prepare_ok we deferred at accept time."""
+        if self.status != STATUS_NORMAL or h["view"] != self.view:
+            return  # view moved on while the fsync was in flight
+        self._send_prepare_ok(h)
+        self._commit_journal(h["commit"])
 
     def _retry_pipeline(self) -> None:
         if not self.pipeline:
@@ -611,10 +661,17 @@ class Replica:
             self._repair_gaps(target=op)
             return
         self.op = op
-        self.journal.write_prepare(msg)
-        self._replicate_chain(msg)
-        self._send_prepare_ok(h)
-        self._commit_journal(h["commit"])
+        if self.wal_group is None:
+            self.journal.write_prepare(msg)
+            self._replicate_chain(msg)
+            self._send_prepare_ok(h)
+            self._commit_journal(h["commit"])
+        else:
+            # Buffer the write, forward down the chain immediately, and
+            # defer prepare_ok to the group fsync (ack-after-durable).
+            self.journal.write_prepare(msg, sync=False)
+            self._replicate_chain(msg)
+            self.wal_group.request(lambda: self._backup_wal_durable(h))
 
     def _replicate_chain(self, prepare: Message) -> None:
         """Forward a freshly-accepted prepare down the replication chain
@@ -1487,6 +1544,11 @@ class Replica:
             + int(h["checksum_body"]).to_bytes(16, "little")
             + results
         )
+        # One compaction beat per committed op, INSIDE the apply path so
+        # WAL replay re-runs the identical beat sequence (deterministic
+        # grid allocation order — reference forest.compact per op,
+        # forest.zig:319, paced by op number).
+        sm.compact_beat()
         self.committed_timestamp_max = max(
             self.committed_timestamp_max, int(h["timestamp"])
         )

@@ -291,6 +291,11 @@ def install(replica, blob: bytes) -> None:
         sm.transfer_index.restore(z["ti_manifest"])
         sm.account_rows.restore(z["ai_manifest"])
         sm.transfer_log.restore(z["log_blocks"], z["log_tail"])
+        # Rebuild the transfer-id Bloom pre-filter (RAM-only, no false
+        # negatives allowed: every stored id must be re-added) by scanning
+        # the restored object log.
+        for _base, recs in sm.transfer_log.scan_range(0, sm.transfer_log.count):
+            sm.transfer_seen.add(recs["id_lo"], recs["id_hi"])
     sm.posted = {
         int(k): int(v) for k, v in zip(z["posted_keys"], z["posted_vals"])
     }
