@@ -239,15 +239,294 @@ int hostops_argsort_u64(int64_t n, const uint64_t *keys, uint32_t *out) {
     return 0;
 }
 
+/* ------------------------------------------------- fast-path staging */
+
+/* One pass over raw 128-byte wire Transfer records doing everything the
+ * Python dispatcher staged in five separate numpy passes: in-batch
+ * duplicate-id detection (hash set), bloom membership pre-filter, account
+ * id -> slot map lookups, the full fast-path validation ladder
+ * (host_kernel.validate + the dispatcher's host rungs, merged at exact
+ * precedence via nonzero-minimum), and exact-kernel routing flags.
+ *
+ * Record layout (types.TRANSFER_DTYPE, byte offsets):
+ *   0 id_lo  8 id_hi  16 dr_lo  24 dr_hi  32 cr_lo  40 cr_hi
+ *   48 amount_lo  56 amount_hi  64 pending_id_lo  72 pending_id_hi
+ *   104 user_data_32(u32) 108 timeout(u32) 112 ledger(u32)
+ *   116 code(u16) 118 flags(u16) 120 timestamp(u64)
+ *
+ * Result codes are the wire-contract values of
+ * results.CreateTransferResult (cross-checked at shim load time by
+ * native/__init__.py).
+ */
+enum {
+    R_TIMESTAMP_MUST_BE_ZERO = 3,
+    R_RESERVED_FLAG = 4,
+    R_ID_MUST_NOT_BE_ZERO = 5,
+    R_ID_MUST_NOT_BE_INT_MAX = 6,
+    R_DR_ID_ZERO = 8,
+    R_DR_ID_MAX = 9,
+    R_CR_ID_ZERO = 10,
+    R_CR_ID_MAX = 11,
+    R_ACCOUNTS_MUST_BE_DIFFERENT = 12,
+    R_PENDING_ID_MUST_BE_ZERO = 13,
+    R_TIMEOUT_RESERVED = 17,
+    R_AMOUNT_MUST_NOT_BE_ZERO = 18,
+    R_LEDGER_MUST_NOT_BE_ZERO = 19,
+    R_CODE_MUST_NOT_BE_ZERO = 20,
+    R_DEBIT_ACCOUNT_NOT_FOUND = 21,
+    R_CREDIT_ACCOUNT_NOT_FOUND = 22,
+    R_SAME_LEDGER = 23,
+    R_TRANSFER_SAME_LEDGER = 24,
+    R_OVERFLOWS_TIMEOUT = 53,
+};
+
+#define F_LINKED   (1u << 0)
+#define F_PENDING  (1u << 1)
+#define F_POST     (1u << 2)
+#define F_VOID     (1u << 3)
+#define F_BAL_DR   (1u << 4)
+#define F_BAL_CR   (1u << 5)
+#define F_EXACT    (F_LINKED | F_POST | F_VOID | F_BAL_DR | F_BAL_CR)
+#define AF_LIMIT_OR_HISTORY ((1u << 1) | (1u << 2) | (1u << 3))
+
+#define LADDER(c, cond, val) do { if ((c) == 0 && (cond)) (c) = (val); } while (0)
+
+/* Reusable duplicate-detection scratch (epoch-tagged: no per-call clear). */
+typedef struct { uint64_t lo, hi; uint32_t epoch; } dup_slot;
+/* _Thread_local: ctypes releases the GIL during calls, so two state
+ * machines driven from different threads must not share scratch. */
+static _Thread_local dup_slot *g_dup = 0;
+static _Thread_local uint64_t g_dup_cap = 0;
+static _Thread_local uint32_t g_dup_epoch = 0;
+
+/* Returns a bitmask: bit0 has_dup, bit1 exact_needed, bit2 any bloom
+ * maybe, bit3 any post/void, bit4 any linked. Negative on alloc failure. */
+int hostops_ct_stage(
+    const uint8_t *events, int64_t n, int64_t stride,
+    uint64_t ts_base,           /* timestamp of event 0 */
+    void *account_map,          /* u128map id -> slot (may be NULL) */
+    const uint32_t *acc_ledger, /* slot-indexed */
+    const uint32_t *acc_flags,
+    const uint64_t *bloom_words, uint64_t bloom_mask, /* words NULL = skip */
+    uint32_t *code,      /* merged fast-path ladder (fast batches only) */
+    uint32_t *host_code, /* dispatcher host rungs alone (exact-path input) */
+    int64_t *dr_slot, int64_t *cr_slot,
+    uint64_t *amt_lo, uint64_t *amt_hi,
+    uint8_t *pend_out, uint8_t *maybe_out
+) {
+    uint64_t cap = 64;
+    while (cap < (uint64_t)n * 2) cap <<= 1;
+    if (cap > g_dup_cap || g_dup_epoch == 0xFFFFFFFFu) {
+        free(g_dup);
+        g_dup = (dup_slot *)calloc(cap, sizeof(dup_slot));
+        if (!g_dup) { g_dup_cap = 0; return -1; }
+        g_dup_cap = cap;
+        g_dup_epoch = 0;
+    }
+    uint64_t dmask = g_dup_cap - 1;
+    uint32_t epoch = ++g_dup_epoch;
+    const u128map *m = (const u128map *)account_map;
+    int out_flags = 0;
+
+    for (int64_t i = 0; i < n; i++) {
+        const uint8_t *r = events + i * stride;
+        uint64_t id_lo, id_hi, dr_lo, dr_hi, cr_lo, cr_hi, a_lo, a_hi;
+        uint64_t p_lo, p_hi, ts_field;
+        uint32_t timeout, ledger;
+        uint16_t tcode, flags;
+        memcpy(&id_lo, r + 0, 8);  memcpy(&id_hi, r + 8, 8);
+        memcpy(&dr_lo, r + 16, 8); memcpy(&dr_hi, r + 24, 8);
+        memcpy(&cr_lo, r + 32, 8); memcpy(&cr_hi, r + 40, 8);
+        memcpy(&a_lo, r + 48, 8);  memcpy(&a_hi, r + 56, 8);
+        memcpy(&p_lo, r + 64, 8);  memcpy(&p_hi, r + 72, 8);
+        memcpy(&timeout, r + 108, 4); memcpy(&ledger, r + 112, 4);
+        memcpy(&tcode, r + 116, 2);   memcpy(&flags, r + 118, 2);
+        memcpy(&ts_field, r + 120, 8);
+        amt_lo[i] = a_lo; amt_hi[i] = a_hi;
+        int pend = (flags & F_PENDING) != 0;
+        pend_out[i] = (uint8_t)pend;
+        if (flags & F_EXACT) out_flags |= 2;
+        if (flags & (F_POST | F_VOID)) out_flags |= 8;
+        if (flags & F_LINKED) out_flags |= 16;
+
+        /* duplicate-id hash set */
+        {
+            uint64_t j = hash128(id_lo, id_hi) & dmask;
+            for (;;) {
+                dup_slot *s = &g_dup[j];
+                if (s->epoch != epoch) {
+                    s->lo = id_lo; s->hi = id_hi; s->epoch = epoch;
+                    break;
+                }
+                if (s->lo == id_lo && s->hi == id_hi) { out_flags |= 1; break; }
+                j = (j + 1) & dmask;
+            }
+        }
+        /* bloom membership pre-filter */
+        if (bloom_words) {
+            uint64_t h1, h2;
+            bloom_hash2(id_lo, id_hi, &h1, &h2);
+            uint64_t b1 = h1 & bloom_mask, b2 = h2 & bloom_mask;
+            uint8_t mb = (uint8_t)(((bloom_words[b1 >> 6] >> (b1 & 63)) & 1)
+                                 & ((bloom_words[b2 >> 6] >> (b2 & 63)) & 1));
+            maybe_out[i] = mb;
+            if (mb) out_flags |= 4;
+        } else {
+            maybe_out[i] = 0;
+        }
+        /* account slot lookups */
+        int64_t ds = -1, cs = -1;
+        if (m) {
+            uint64_t j = hash128(dr_lo, dr_hi) & m->mask;
+            for (;;) {
+                const map_slot *s = &m->slots[j];
+                if (!s->used) break;
+                if (s->lo == dr_lo && s->hi == dr_hi) { ds = s->val; break; }
+                j = (j + 1) & m->mask;
+            }
+            j = hash128(cr_lo, cr_hi) & m->mask;
+            for (;;) {
+                const map_slot *s = &m->slots[j];
+                if (!s->used) break;
+                if (s->lo == cr_lo && s->hi == cr_hi) { cs = s->val; break; }
+                j = (j + 1) & m->mask;
+            }
+        }
+        dr_slot[i] = ds; cr_slot[i] = cs;
+        if (ds >= 0 && (acc_flags[ds] & AF_LIMIT_OR_HISTORY)) out_flags |= 2;
+        if (cs >= 0 && (acc_flags[cs] & AF_LIMIT_OR_HISTORY)) out_flags |= 2;
+
+        /* host-rung ladder (dispatcher order, post/void events excluded
+         * from the account-id rungs — they branch to their own ladder) */
+        int is_pv = (flags & (F_POST | F_VOID)) != 0;
+        uint32_t hc = 0;
+        LADDER(hc, ts_field != 0, R_TIMESTAMP_MUST_BE_ZERO);
+        if (!is_pv) {
+            LADDER(hc, dr_lo == 0 && dr_hi == 0, R_DR_ID_ZERO);
+            LADDER(hc, dr_lo == ~0ull && dr_hi == ~0ull, R_DR_ID_MAX);
+            LADDER(hc, cr_lo == 0 && cr_hi == 0, R_CR_ID_ZERO);
+            LADDER(hc, cr_lo == ~0ull && cr_hi == ~0ull, R_CR_ID_MAX);
+            LADDER(hc, dr_lo == cr_lo && dr_hi == cr_hi,
+                   R_ACCOUNTS_MUST_BE_DIFFERENT);
+        }
+        /* kernel-rung ladder (host_kernel.validate order) */
+        uint32_t kc = 0;
+        LADDER(kc, (flags & 0xFFC0u) != 0, R_RESERVED_FLAG);
+        LADDER(kc, id_lo == 0 && id_hi == 0, R_ID_MUST_NOT_BE_ZERO);
+        LADDER(kc, id_lo == ~0ull && id_hi == ~0ull, R_ID_MUST_NOT_BE_INT_MAX);
+        LADDER(kc, p_lo != 0 || p_hi != 0, R_PENDING_ID_MUST_BE_ZERO);
+        LADDER(kc, !pend && timeout != 0, R_TIMEOUT_RESERVED);
+        LADDER(kc, a_lo == 0 && a_hi == 0, R_AMOUNT_MUST_NOT_BE_ZERO);
+        LADDER(kc, ledger == 0, R_LEDGER_MUST_NOT_BE_ZERO);
+        LADDER(kc, tcode == 0, R_CODE_MUST_NOT_BE_ZERO);
+        LADDER(kc, ds < 0, R_DEBIT_ACCOUNT_NOT_FOUND);
+        LADDER(kc, cs < 0, R_CREDIT_ACCOUNT_NOT_FOUND);
+        if (kc == 0 && ds >= 0 && cs >= 0) {
+            uint32_t dl = acc_ledger[ds], cl = acc_ledger[cs];
+            LADDER(kc, dl != cl, R_SAME_LEDGER);
+            LADDER(kc, ledger != dl, R_TRANSFER_SAME_LEDGER);
+        }
+        {
+            uint64_t ts = ts_base + (uint64_t)i;
+            uint64_t tns = (uint64_t)timeout * 1000000000ull;
+            LADDER(kc, tns > ~0ull - ts, R_OVERFLOWS_TIMEOUT);
+        }
+        /* nonzero-minimum merge (results are precedence-ordered) */
+        uint32_t c;
+        if (hc == 0) c = kc;
+        else if (kc == 0) c = hc;
+        else c = hc < kc ? hc : kc;
+        code[i] = c;
+        host_code[i] = hc;
+    }
+    return out_flags;
+}
+
+/* Build lo-major stable-sorted (key, value) arrays for memtable insertion
+ * straight from raw wire records — replaces pack_keys + concat + radix
+ * argsort + gather numpy passes. Column 2 (off2 >= 0) appends a second
+ * key per record AFTER all first keys (the Python concat order), with the
+ * same value sequence. Values are val_base + (i % n). out_keys is
+ * KEY_DTYPE layout: (hi u64, lo u64) pairs. Returns 0, or -1 on alloc
+ * failure. */
+int hostops_build_sorted_kv(
+    const uint8_t *recs, int64_t n, int64_t stride,
+    int64_t off_lo1, int64_t off_hi1,
+    int64_t off_lo2, int64_t off_hi2,
+    uint32_t val_base,
+    uint8_t *out_keys, uint32_t *out_vals
+) {
+    int64_t m = off_lo2 >= 0 ? 2 * n : n;
+    uint64_t *lo = (uint64_t *)malloc((size_t)m * 8);
+    uint64_t *hi = (uint64_t *)malloc((size_t)m * 8);
+    uint32_t *idx = (uint32_t *)malloc((size_t)m * 4);
+    if (!lo || !hi || !idx) { free(lo); free(hi); free(idx); return -1; }
+    for (int64_t i = 0; i < n; i++) {
+        const uint8_t *r = recs + i * stride;
+        memcpy(&lo[i], r + off_lo1, 8);
+        memcpy(&hi[i], r + off_hi1, 8);
+        if (off_lo2 >= 0) {
+            memcpy(&lo[n + i], r + off_lo2, 8);
+            memcpy(&hi[n + i], r + off_hi2, 8);
+        }
+    }
+    if (hostops_argsort_u64(m, lo, idx) != 0) {
+        free(lo); free(hi); free(idx); return -1;
+    }
+    for (int64_t i = 0; i < m; i++) {
+        uint32_t j = idx[i];
+        memcpy(out_keys + i * 16, &hi[j], 8);
+        memcpy(out_keys + i * 16 + 8, &lo[j], 8);
+        out_vals[i] = val_base + (uint32_t)(j < n ? j : j - n);
+    }
+    free(lo); free(hi); free(idx);
+    return 0;
+}
+
+/* Unsorted sibling of hostops_build_sorted_kv: extract (key, value)
+ * arrays in record order (column-1 block then column-2 block, the Python
+ * concat order) with no sort — for memtables whose flush re-sorts anyway. */
+int hostops_extract_kv(
+    const uint8_t *recs, int64_t n, int64_t stride,
+    int64_t off_lo1, int64_t off_hi1,
+    int64_t off_lo2, int64_t off_hi2,
+    uint32_t val_base,
+    uint8_t *out_keys, uint32_t *out_vals
+) {
+    for (int64_t i = 0; i < n; i++) {
+        const uint8_t *r = recs + i * stride;
+        memcpy(out_keys + i * 16, r + off_hi1, 8);
+        memcpy(out_keys + i * 16 + 8, r + off_lo1, 8);
+        out_vals[i] = val_base + (uint32_t)i;
+        if (off_lo2 >= 0) {
+            memcpy(out_keys + (n + i) * 16, r + off_hi2, 8);
+            memcpy(out_keys + (n + i) * 16 + 8, r + off_lo2, 8);
+            out_vals[n + i] = val_base + (uint32_t)i;
+        }
+    }
+    return 0;
+}
+
 /* ------------------------------------------------------- u128 posting */
 
 typedef unsigned __int128 u128;
 
 typedef struct {
-    int64_t slot;
     u128 d_pend, d_post, c_pend, c_post;
-    int used;
-} post_slot;
+} post_delta;
+
+/* Reusable posting scratch, split into a compact probe table (slot id +
+ * epoch + dense index — 16 bytes per probe line vs the old 80-byte
+ * struct) and a dense delta array indexed by discovery order. Epoch tags
+ * skip per-call clearing; phases 2-3 walk only the dense entries. The
+ * old per-call multi-MB calloc + full-capacity sweep dominated this
+ * function's cost. */
+typedef struct { int64_t slot; uint32_t epoch; uint32_t dense; } post_probe;
+static _Thread_local post_probe *g_post_probe = 0;
+static _Thread_local post_delta *g_post_delta = 0;
+static _Thread_local int64_t *g_post_dense_slot = 0;
+static _Thread_local uint64_t g_post_cap = 0;
+static _Thread_local uint32_t g_post_epoch = 0;
 
 /* Exact two-phase balance posting over four (rows, 4)-u32-limb tables
  * (little-endian limbs: value = l0 + l1<<32 + l2<<64 + l3<<96).
@@ -266,21 +545,43 @@ int hostops_post_u128(
     const uint8_t *pend_mask, const uint8_t *post_mask
 ) {
     uint64_t cap = 64;
-    while (cap < (uint64_t)n * 4) cap <<= 1; /* 2n slot refs, load < 0.5 */
-    uint64_t mask = cap - 1;
-    post_slot *acc = (post_slot *)calloc(cap, sizeof(post_slot));
-    if (!acc) return -1;
+    while (cap < (uint64_t)n * 4) cap <<= 1; /* 2n distinct max, load <= 0.5 */
+    if (cap > g_post_cap || g_post_epoch == 0xFFFFFFFFu) {
+        free(g_post_probe); free(g_post_delta); free(g_post_dense_slot);
+        g_post_probe = (post_probe *)calloc(cap, sizeof(post_probe));
+        g_post_delta = (post_delta *)malloc((cap / 2) * sizeof(post_delta));
+        g_post_dense_slot = (int64_t *)malloc((cap / 2) * sizeof(int64_t));
+        if (!g_post_probe || !g_post_delta || !g_post_dense_slot) {
+            free(g_post_probe); free(g_post_delta); free(g_post_dense_slot);
+            g_post_probe = 0; g_post_delta = 0; g_post_dense_slot = 0;
+            g_post_cap = 0;
+            return -1;
+        }
+        g_post_cap = cap;
+        g_post_epoch = 0;
+    }
+    uint64_t mask = g_post_cap - 1;
+    post_probe *probe = g_post_probe;
+    post_delta *delta = g_post_delta;
+    uint32_t epoch = ++g_post_epoch;
+    uint32_t n_dense = 0;
 
     int overflow = 0;
 
     #define ACC_FIND(slot_id, out_ptr) do {                                \
         uint64_t _i = mix64((uint64_t)(slot_id)) & mask;                   \
         for (;;) {                                                         \
-            if (!acc[_i].used) {                                           \
-                acc[_i].used = 1; acc[_i].slot = (slot_id);                \
-                (out_ptr) = &acc[_i]; break;                               \
+            if (probe[_i].epoch != epoch) {                                \
+                probe[_i].epoch = epoch; probe[_i].slot = (slot_id);       \
+                probe[_i].dense = n_dense;                                 \
+                g_post_dense_slot[n_dense] = (slot_id);                    \
+                post_delta *_d = &delta[n_dense++];                        \
+                _d->d_pend = _d->d_post = _d->c_pend = _d->c_post = 0;     \
+                (out_ptr) = _d; break;                                     \
             }                                                              \
-            if (acc[_i].slot == (slot_id)) { (out_ptr) = &acc[_i]; break; }\
+            if (probe[_i].slot == (slot_id)) {                             \
+                (out_ptr) = &delta[probe[_i].dense]; break;                \
+            }                                                              \
             _i = (_i + 1) & mask;                                          \
         }                                                                  \
     } while (0)
@@ -289,7 +590,7 @@ int hostops_post_u128(
         int p = pend_mask[i], q = post_mask[i];
         if (!p && !q) continue;
         u128 amt = ((u128)amt_hi[i] << 64) | amt_lo[i];
-        post_slot *sd, *sc;
+        post_delta *sd, *sc;
         ACC_FIND(dr[i], sd);
         ACC_FIND(cr[i], sc);
         if (p) {
@@ -313,33 +614,32 @@ int hostops_post_u128(
     } while (0)
 
     /* Phase 2: validate all, then write all. */
-    for (uint64_t i = 0; i < cap && !overflow; i++) {
-        if (!acc[i].used) continue;
-        int64_t s = acc[i].slot;
-        u128 ndp = LOAD128(dp, s) + acc[i].d_pend;
-        if (ndp < acc[i].d_pend) overflow = 1;
-        u128 ndpo = LOAD128(dpo, s) + acc[i].d_post;
-        if (ndpo < acc[i].d_post) overflow = 1;
-        u128 ncp = LOAD128(cp, s) + acc[i].c_pend;
-        if (ncp < acc[i].c_pend) overflow = 1;
-        u128 ncpo = LOAD128(cpo, s) + acc[i].c_post;
-        if (ncpo < acc[i].c_post) overflow = 1;
+    for (uint32_t t = 0; t < n_dense && !overflow; t++) {
+        post_delta *a = &delta[t];
+        int64_t s = g_post_dense_slot[t];
+        u128 ndp = LOAD128(dp, s) + a->d_pend;
+        if (ndp < a->d_pend) overflow = 1;
+        u128 ndpo = LOAD128(dpo, s) + a->d_post;
+        if (ndpo < a->d_post) overflow = 1;
+        u128 ncp = LOAD128(cp, s) + a->c_pend;
+        if (ncp < a->c_pend) overflow = 1;
+        u128 ncpo = LOAD128(cpo, s) + a->c_post;
+        if (ncpo < a->c_post) overflow = 1;
         if (ndp + ndpo < ndp) overflow = 1;   /* overflows_debits  */
         if (ncp + ncpo < ncp) overflow = 1;   /* overflows_credits */
     }
     if (!overflow) {
-        for (uint64_t i = 0; i < cap; i++) {
-            if (!acc[i].used) continue;
-            int64_t s = acc[i].slot;
+        for (uint32_t t = 0; t < n_dense; t++) {
+            post_delta *a = &delta[t];
+            int64_t s = g_post_dense_slot[t];
             u128 v;
-            v = LOAD128(dp, s) + acc[i].d_pend;  STORE128(dp, s, v);
-            v = LOAD128(dpo, s) + acc[i].d_post; STORE128(dpo, s, v);
-            v = LOAD128(cp, s) + acc[i].c_pend;  STORE128(cp, s, v);
-            v = LOAD128(cpo, s) + acc[i].c_post; STORE128(cpo, s, v);
+            v = LOAD128(dp, s) + a->d_pend;  STORE128(dp, s, v);
+            v = LOAD128(dpo, s) + a->d_post; STORE128(dpo, s, v);
+            v = LOAD128(cp, s) + a->c_pend;  STORE128(cp, s, v);
+            v = LOAD128(cpo, s) + a->c_post; STORE128(cpo, s, v);
         }
     }
     #undef LOAD128
     #undef STORE128
-    free(acc);
     return overflow;
 }

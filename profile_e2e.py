@@ -18,7 +18,6 @@ from tigerbeetle_tpu.io.storage import FileStorage, Zone
 from tigerbeetle_tpu.vsr import header as hdr
 from tigerbeetle_tpu.vsr.header import Command, Header, Message, Operation
 from tigerbeetle_tpu.vsr.replica import Replica
-from tigerbeetle_tpu.cli import FileSnapshotStore
 
 BATCH = 8190
 
@@ -51,8 +50,7 @@ def main(backend="numpy", batches=40):
     bus = DummyBus()
     replica = Replica(
         cluster=0, replica_index=0, replica_count=1, storage=storage,
-        zone=zone, config=config, bus=bus,
-        snapshot_store=FileSnapshotStore(path), sm_backend=backend,
+        zone=zone, config=config, bus=bus, sm_backend=backend,
     )
     replica.open()
 

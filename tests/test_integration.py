@@ -31,14 +31,15 @@ class ServerThread:
     """Runs one ReplicaServer in a background asyncio loop."""
 
     def __init__(self, path: str, port: int, fresh: bool = True) -> None:
-        from tigerbeetle_tpu.cli import FileSnapshotStore
         from tigerbeetle_tpu.io.storage import FileStorage, Zone
         from tigerbeetle_tpu.net.bus import ReplicaServer
         from tigerbeetle_tpu.vsr.replica import Replica
 
         config = TEST_MIN
         zone = Zone.for_config(
-            config.journal_slot_count, config.message_size_max
+            config.journal_slot_count, config.message_size_max,
+            grid_block_count=config.grid_block_count,
+            grid_block_size=config.lsm_block_size,
         )
         if fresh:
             st = FileStorage(path, size=zone.total_size, create=True)
@@ -48,7 +49,7 @@ class ServerThread:
         self.replica = Replica(
             cluster=0, replica_index=0, replica_count=1,
             storage=self.storage, zone=zone, config=config,
-            bus=None, snapshot_store=FileSnapshotStore(path), sm_backend="numpy",
+            bus=None, sm_backend="numpy",
         )
         self.server = ReplicaServer(self.replica, [("127.0.0.1", port)])
         self.replica.open()
@@ -141,6 +142,64 @@ def test_restart_preserves_state(tmp_path):
         s2.stop()
 
 
+def test_checkpoint_restart_single_data_file(tmp_path):
+    """Checkpoint state lives in grid blocks referenced from the superblock
+    (the checkpoint-trailer design, reference checkpoint_trailer.zig +
+    superblock.zig:22 single-file invariant): a replica that crossed a
+    checkpoint restarts from the ONE data file — no side files exist."""
+    import glob
+
+    port = free_port()
+    path = str(tmp_path / "data.tb")
+    s = ServerThread(path, port)
+    client = Client([("127.0.0.1", port)])
+    ids = list(range(1, 11))
+    client.create_accounts(types.batch(
+        [types.account(id=i, ledger=1, code=10) for i in ids],
+        types.ACCOUNT_DTYPE,
+    ))
+    # TEST_MIN checkpoint_interval=16: drive well past one checkpoint.
+    tid = 1
+    for _ in range(40):
+        transfers = types.batch(
+            [types.transfer(id=tid, debit_account_id=1, credit_account_id=2,
+                            amount=3, ledger=1, code=1)],
+            types.TRANSFER_DTYPE,
+        )
+        assert len(client.create_transfers(transfers)) == 0
+        tid += 1
+    assert s.replica.superblock.state.op_checkpoint > 0
+    from tigerbeetle_tpu.vsr.superblock import NO_TRAILER
+
+    assert s.replica.superblock.state.trailer_block != NO_TRAILER
+    client.close()
+    s.storage.sync()
+    s.stop()
+
+    # ONE data file: nothing else was written next to it.
+    siblings = sorted(glob.glob(path + "*"))
+    assert siblings == [path], siblings
+
+    port2 = free_port()
+    s2 = ServerThread(path, port2, fresh=False)
+    try:
+        assert s2.replica.superblock.state.op_checkpoint > 0
+        client2 = Client([("127.0.0.1", port2)])
+        out = client2.lookup_accounts([1, 2])
+        assert types.u128_of(out[0], "debits_posted") == 3 * 40
+        assert types.u128_of(out[1], "credits_posted") == 3 * 40
+        # The store survives too: a duplicate id still reports EXISTS.
+        res = client2.create_transfers(types.batch(
+            [types.transfer(id=1, debit_account_id=1, credit_account_id=2,
+                            amount=3, ledger=1, code=1)],
+            types.TRANSFER_DTYPE,
+        ))
+        assert len(res) == 1 and int(res[0]["result"]) != 0
+        client2.close()
+    finally:
+        s2.stop()
+
+
 def test_cli_format_and_version(tmp_path, capsys):
     from tigerbeetle_tpu.cli import main
 
@@ -156,14 +215,15 @@ class MultiServerThread:
     """Three replicas in one background asyncio loop (shared for the test)."""
 
     def __init__(self, tmp, ports):
-        from tigerbeetle_tpu.cli import FileSnapshotStore
         from tigerbeetle_tpu.io.storage import FileStorage, Zone
         from tigerbeetle_tpu.net.bus import ReplicaServer
         from tigerbeetle_tpu.vsr.replica import Replica
 
         config = TEST_MIN
         zone = Zone.for_config(
-            config.journal_slot_count, config.message_size_max
+            config.journal_slot_count, config.message_size_max,
+            grid_block_count=config.grid_block_count,
+            grid_block_size=config.lsm_block_size,
         )
         addresses = [("127.0.0.1", p) for p in ports]
         self.servers = []
@@ -175,8 +235,7 @@ class MultiServerThread:
             replica = Replica(
                 cluster=0, replica_index=i, replica_count=3,
                 storage=st, zone=zone, config=config,
-                bus=None, snapshot_store=FileSnapshotStore(path),
-                sm_backend="numpy",
+                bus=None, sm_backend="numpy",
             )
             self.servers.append(ReplicaServer(replica, addresses))
             self.storages.append(st)

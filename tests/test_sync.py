@@ -170,7 +170,7 @@ class TestChunkedSync:
         live = [r for r in cl.replicas if r is not None]
         assert all(r.superblock.state.op_checkpoint >= 16 for r in live)
         primary = next(r for r in live if r.is_primary)
-        blob = primary.snapshot_store.load(primary.superblock.state.op_checkpoint)
+        blob = primary._trailer_read(primary.superblock.state.trailer_block)
         chunk = TEST_MIN.message_size_max - hdr.HEADER_SIZE
         assert len(blob) > 3 * chunk, "state must span several sync chunks"
         return cl, bi, c

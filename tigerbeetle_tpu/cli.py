@@ -46,45 +46,6 @@ def cmd_format(args) -> int:
     return 0
 
 
-class FileSnapshotStore:
-    """Op-tagged snapshot files: <path>.snapshot.<op>; older ops are pruned
-    only after the superblock checkpoint is durable."""
-
-    def __init__(self, path: str) -> None:
-        self.base = path + ".snapshot"
-
-    def _path(self, op: int) -> str:
-        return f"{self.base}.{op}"
-
-    def save(self, op: int, blob: bytes) -> None:
-        import os
-
-        tmp = self._path(op) + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(blob)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._path(op))
-
-    def load(self, op: int):
-        try:
-            with open(self._path(op), "rb") as f:
-                return f.read()
-        except FileNotFoundError:
-            return None
-
-    def prune(self, keep_op: int) -> None:
-        import glob
-        import os
-
-        for p in glob.glob(self.base + ".*"):
-            if not p.endswith(f".{keep_op}") and not p.endswith(".tmp"):
-                try:
-                    os.remove(p)
-                except OSError:
-                    pass
-
-
 def cmd_start(args) -> int:
     from tigerbeetle_tpu.constants import config_by_name
     from tigerbeetle_tpu.io.storage import FileStorage, Zone
@@ -114,7 +75,6 @@ def cmd_start(args) -> int:
         zone=zone,
         config=config,
         bus=None,  # injected by ReplicaServer
-        snapshot_store=FileSnapshotStore(args.path),
         sm_backend=args.backend,
         time=SystemTime(),
         aof=aof,

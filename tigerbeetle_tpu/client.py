@@ -117,7 +117,10 @@ class Client:
         self._bufs[r] = buf
         return out
 
-    def _roundtrip(self, operation: int, body: bytes) -> Message:
+    def _roundtrip(self, operation: int, body) -> Message:
+        """body: bytes or a numpy record array (zero-copy: the MAC runs
+        over the array memory and the frame goes out as header + body via
+        sendmsg — no 1 MiB concatenations)."""
         import select as _select
 
         self.request_number += 1
@@ -126,6 +129,9 @@ class Client:
             client=self.id, request=self.request_number, operation=operation,
         )
         msg = Message(req, body).seal()
+        frame = [msg.header.to_bytes()]
+        if (body.nbytes if isinstance(body, np.ndarray) else len(body)) > 0:
+            frame.append(body)
         attempts = 4 * len(self.addresses) + 4
         for _ in range(attempts):
             self._ensure_connections()
@@ -135,7 +141,7 @@ class Client:
                 self._target += 1
                 continue
             try:
-                s.sendall(msg.to_bytes())
+                self._send_frame(s, frame)
             except OSError:
                 self._socks.pop(target, None)
                 self._target += 1
@@ -174,6 +180,34 @@ class Client:
             self._target += 1
         raise ClientError("request timed out against every replica")
 
+    @staticmethod
+    def _send_frame(s: socket.socket, parts: list) -> None:
+        """Write header+body without concatenating (sendmsg gathers
+        directly from the caller's buffers, numpy arrays included).
+        Handles partial writes/EAGAIN on the non-blocking socket."""
+        import select as _select
+
+        mv = [memoryview(p).cast("B") for p in parts]
+        deadline = time.monotonic() + Client.REQUEST_TIMEOUT
+        idx = 0
+        while idx < len(mv):
+            try:
+                sent = s.sendmsg(mv[idx:])
+            except (BlockingIOError, InterruptedError):
+                # Bounded: a stalled replica must surface as OSError so the
+                # caller rotates to the next one, not hang this send forever.
+                if time.monotonic() >= deadline:
+                    raise BrokenPipeError("send stalled (replica not reading)")
+                _select.select([], [s], [], max(0.0, deadline - time.monotonic()))
+                continue
+            while sent > 0:
+                if sent >= len(mv[idx]):
+                    sent -= len(mv[idx])
+                    idx += 1
+                else:
+                    mv[idx] = mv[idx][sent:]
+                    sent = 0
+
     # --- session --------------------------------------------------------
 
     def register(self) -> None:
@@ -196,11 +230,15 @@ class Client:
     # --- typed operations ----------------------------------------------
 
     def create_accounts(self, accounts: np.ndarray) -> np.ndarray:
-        reply = self._roundtrip(Operation.CREATE_ACCOUNTS, accounts.tobytes())
+        reply = self._roundtrip(
+            Operation.CREATE_ACCOUNTS, np.ascontiguousarray(accounts)
+        )
         return np.frombuffer(bytearray(reply.body), dtype=types.EVENT_RESULT_DTYPE)
 
     def create_transfers(self, transfers: np.ndarray) -> np.ndarray:
-        reply = self._roundtrip(Operation.CREATE_TRANSFERS, transfers.tobytes())
+        reply = self._roundtrip(
+            Operation.CREATE_TRANSFERS, np.ascontiguousarray(transfers)
+        )
         return np.frombuffer(bytearray(reply.body), dtype=types.EVENT_RESULT_DTYPE)
 
     def _ids_body(self, ids: Sequence[int]) -> bytes:

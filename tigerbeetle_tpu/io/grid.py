@@ -166,6 +166,22 @@ class Grid:
         self._cache_put(index, bytes(payload))
         return index
 
+    def write_block_at(self, index: int, payload: bytes, block_type: int = 0) -> None:
+        """Write a specific PRE-ACQUIRED block (checkpoint trailer chunks:
+        the block set is reserved first so the encoded free set can account
+        for it, then each chunk lands in its reserved slot)."""
+        assert len(payload) <= self.payload_max
+        assert not self.free_set.free[index], f"block {index} not acquired"
+        head = np.zeros((), dtype=_BLOCK_HEADER_DTYPE)
+        head["size"] = len(payload)
+        head["block_type"] = block_type
+        c = _checksum(payload)
+        head["checksum_lo"] = c & ((1 << 64) - 1)
+        head["checksum_hi"] = c >> 64
+        self.storage.write(self._addr(index), head.tobytes() + payload)
+        self.writes += 1
+        self._cache_put(index, bytes(payload))
+
     def read_block(self, index: int) -> bytes:
         """Return the payload; raises on checksum mismatch (corrupt block)."""
         cached = self._cache.get(index)

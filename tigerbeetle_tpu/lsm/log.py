@@ -61,7 +61,11 @@ class DurableLog:
             self._tail_len += take
             off += take
             if self._tail_len == rpb:
-                self._pending_blocks.append(self._tail.copy())
+                # Move, don't copy: the full tail becomes the pending block
+                # and a fresh (uninitialized — only [:tail_len] is ever
+                # read) buffer takes its place.
+                self._pending_blocks.append(self._tail)
+                self._tail = np.empty(rpb, dtype=self.dtype)
                 self._tail_len = 0
         return rows
 

@@ -156,6 +156,7 @@ class DurableIndex:
         self.growth = growth
         self.backend = backend
         self._mem: List[Tuple[np.ndarray, np.ndarray]] = []
+        self._mem_sorted: List[bool] = []  # per-batch lo-major-sorted flag
         self._mem_count = 0
         # levels[0] is newest-flush tables (append order = age order).
         self.levels: List[List[TableInfo]] = [[]]
@@ -181,11 +182,44 @@ class DurableIndex:
         vals = np.asarray(values, dtype=np.uint32)
         # Sort each batch once at insert time so lookups never re-sort.
         order = sort_lo_major(keys)
-        self._mem.append((keys[order], vals[order]))
+        self.insert_sorted(keys[order], vals[order])
+
+    def insert_sorted(self, keys: np.ndarray, vals: np.ndarray) -> None:
+        """Append a batch already in lo-major stable order (the C staging
+        path pre-sorts during extraction, hostops_build_sorted_kv)."""
+        if len(keys) == 0:
+            return
+        self._mem.append((keys, vals))
+        self._mem_sorted.append(True)
         self._mem_count += len(keys)
         self.count += len(keys)
         if self._mem_count >= self.memtable_max:
             self.flush_memtable()
+
+    def insert_unsorted(self, keys: np.ndarray, vals: np.ndarray) -> None:
+        """Append WITHOUT per-batch sorting — for write-heavy non-unique
+        indexes whose reads either tolerate unsorted memtable batches
+        (lookup_range scans them with a mask) or trigger the lazy sort in
+        lookup_batch. The flush re-sorts the whole memtable anyway, so
+        deferring drops one radix pass per commit off the hot path."""
+        if len(keys) == 0:
+            return
+        self._mem.append((keys, vals))
+        self._mem_sorted.append(False)
+        self._mem_count += len(keys)
+        self.count += len(keys)
+        if self._mem_count >= self.memtable_max:
+            self.flush_memtable()
+
+    def _sort_mem_lazily(self) -> None:
+        """Point-lookup prerequisite: every memtable batch lo-major sorted
+        (unsorted ones arrive via insert_unsorted)."""
+        if len(self._mem_sorted) < len(self._mem) or not all(self._mem_sorted):
+            for i, (k, v) in enumerate(self._mem):
+                if i >= len(self._mem_sorted) or not self._mem_sorted[i]:
+                    order = sort_lo_major(k)
+                    self._mem[i] = (k[order], v[order])
+            self._mem_sorted = [True] * len(self._mem)
 
     def flush_memtable(self) -> None:
         """Write the memtable as one sorted level-0 table. Compaction is
@@ -198,6 +232,7 @@ class DurableIndex:
         vals = np.concatenate([v for _, v in self._mem])
         order = sort_lo_major(keys)
         self._mem = []
+        self._mem_sorted = []
         self._mem_count = 0
         table = self._build_table(keys[order], vals[order])
         self.levels[0].append(table)
@@ -400,7 +435,9 @@ class DurableIndex:
             return out
         pending = np.ones(n, dtype=bool)
         # Memtable first (newest writes win for unique indexes); batches
-        # are lo-major-sorted at insert time.
+        # are lo-major-sorted at insert time (or lazily, for the unsorted
+        # write-heavy path).
+        self._sort_mem_lazily()
         for mem_keys, mem_vals in reversed(self._mem):
             search_run(mem_keys, mem_vals, keys, out, pending)
         if not pending.any():
@@ -494,8 +531,41 @@ class DurableIndex:
                 )
         return np.array(rows, dtype=MANIFEST_DTYPE)
 
+    def checkpoint_fences(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(concatenated fence rows, per-table fence counts) in manifest
+        row order. Persisted alongside the manifest so a restored tree
+        knows every data-block address WITHOUT grid reads — checkpoint
+        encoding (snapshot.referenced_blocks) then never touches storage,
+        and a restored-from-blob tree is fence-complete immediately."""
+        fences = []
+        counts = []
+        for tables in self.levels:
+            for t in tables:
+                f = self._table_fences(t)
+                fences.append(f)
+                counts.append(len(f))
+        if not fences:
+            return (
+                np.zeros(0, dtype=INDEX_ENTRY_DTYPE),
+                np.zeros(0, dtype=np.uint32),
+            )
+        return np.concatenate(fences), np.array(counts, dtype=np.uint32)
+
+    def attach_fences(self, fences: np.ndarray, counts: np.ndarray) -> None:
+        """Re-attach checkpointed fence arrays after restore() (same
+        manifest row order as checkpoint_fences)."""
+        off = 0
+        i = 0
+        for tables in self.levels:
+            for t in tables:
+                c = int(counts[i])
+                t._fences = fences[off : off + c]
+                off += c
+                i += 1
+
     def restore(self, manifest: np.ndarray) -> None:
         self._mem = []
+        self._mem_sorted = []
         self._mem_count = 0
         self.levels = [[]]
         self.count = 0

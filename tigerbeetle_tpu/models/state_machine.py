@@ -249,6 +249,8 @@ class StateMachine:
         with tracer.span("sm.store.log"):
             rows = self.transfer_log.append_batch(recs, ts=ts)
             self.transfer_seen.add(recs["id_lo"], recs["id_hi"])
+        if self._store_native(recs, int(rows[0]) if len(rows) else 0):
+            return
         with tracer.span("sm.store.idx"):
             self.transfer_index.insert_batch(
                 pack_keys(recs["id_lo"], recs["id_hi"]), rows
@@ -259,6 +261,63 @@ class StateMachine:
                 pack_keys(recs["credit_account_id_lo"], recs["credit_account_id_hi"]),
             ])
             self.account_rows.insert_batch(acct_keys, np.concatenate([rows, rows]))
+
+    def _store_native(self, recs: np.ndarray, row_base: int) -> bool:
+        """C-fused index staging (hostops_build_sorted_kv): builds the
+        lo-major sorted (key, row) arrays for both the transfer-id index
+        and the account secondary index straight from the wire records —
+        one pass each instead of pack/concat/argsort/gather numpy passes.
+        Sorted-batch order is bit-identical to the numpy path (same stable
+        radix order, same dr-then-cr concat order)."""
+        from tigerbeetle_tpu.lsm.store import _hostops
+
+        lib = _hostops()
+        n = len(recs)
+        if (
+            lib is None or n <= 256
+            or recs.strides[0] != recs.dtype.itemsize
+        ):
+            return False
+        import ctypes
+
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        rec_ptr = ctypes.c_char_p(recs.ctypes.data)
+        stride = recs.strides[0]
+        with tracer.span("sm.store.idx"):
+            id_keys = np.empty(n, dtype=KEY_DTYPE)
+            id_vals = np.empty(n, dtype=np.uint32)
+            rc = lib.hostops_build_sorted_kv(
+                rec_ptr, n, stride, 0, 8, -1, -1, row_base,
+                ctypes.c_char_p(id_keys.ctypes.data),
+                id_vals.ctypes.data_as(u32p),
+            )
+            if rc != 0:
+                return False
+            self.transfer_index.insert_sorted(id_keys, id_vals)
+        with tracer.span("sm.store.rows"):
+            # Unsorted extraction: account_rows is non-unique and
+            # write-heavy — lookup_range scans memtable batches with a
+            # mask and the flush re-sorts, so the per-commit radix pass
+            # is pure waste here.
+            acct_keys = np.empty(2 * n, dtype=KEY_DTYPE)
+            acct_vals = np.empty(2 * n, dtype=np.uint32)
+            rc = lib.hostops_extract_kv(
+                rec_ptr, n, stride, 16, 24, 32, 40, row_base,
+                ctypes.c_char_p(acct_keys.ctypes.data),
+                acct_vals.ctypes.data_as(u32p),
+            )
+            if rc != 0:
+                # The id insert already landed; finish the account index via
+                # the numpy path to stay consistent.
+                rows = row_base + np.arange(n, dtype=np.uint32)
+                ak = np.concatenate([
+                    pack_keys(recs["debit_account_id_lo"], recs["debit_account_id_hi"]),
+                    pack_keys(recs["credit_account_id_lo"], recs["credit_account_id_hi"]),
+                ])
+                self.account_rows.insert_batch(ak, np.concatenate([rows, rows]))
+                return True
+            self.account_rows.insert_unsorted(acct_keys, acct_vals)
+        return True
 
     # ------------------------------------------------------------------
     # prepare (timestamp assignment, reference state_machine.zig:503-511)
@@ -437,6 +496,58 @@ class StateMachine:
     # ------------------------------------------------------------------
     # create_transfers
 
+    def _ct_stage_native(self, events: np.ndarray, timestamp: int):
+        """One C pass (csrc/hostops.c hostops_ct_stage) replacing the
+        dispatcher's five numpy staging passes: duplicate-id set, bloom
+        pre-filter, slot lookups, the merged fast-path validation ladder,
+        and exact-kernel routing bits. None when the shim or the native
+        account map is unavailable (numpy fallback below)."""
+        from tigerbeetle_tpu.lsm.store import NativeU128Map, _hostops
+
+        lib = _hostops()
+        if (
+            lib is None
+            or not isinstance(self.account_index, NativeU128Map)
+            or events.strides[0] != events.dtype.itemsize
+        ):
+            return None
+        import ctypes
+
+        n = len(events)
+        code = np.empty(n, dtype=np.uint32)
+        host_code = np.empty(n, dtype=np.uint32)
+        dr_slots = np.empty(n, dtype=np.int64)
+        cr_slots = np.empty(n, dtype=np.int64)
+        amt_lo = np.empty(n, dtype=np.uint64)
+        amt_hi = np.empty(n, dtype=np.uint64)
+        pend = np.empty(n, dtype=np.uint8)
+        maybe = np.empty(n, dtype=np.uint8)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        bloom = self.transfer_seen
+        bloom_ptr = (
+            bloom.words.ctypes.data_as(u64p) if bloom.count else None
+        )
+        acc_ledger = self.acc_ledger
+        acc_flags = self.acc_flags
+        bits = lib.hostops_ct_stage(
+            ctypes.c_char_p(events.ctypes.data), n, events.strides[0],
+            int(timestamp) - n + 1,
+            self.account_index._h,
+            acc_ledger.ctypes.data_as(u32p), acc_flags.ctypes.data_as(u32p),
+            bloom_ptr, int(bloom._mask),
+            code.ctypes.data_as(u32p), host_code.ctypes.data_as(u32p),
+            dr_slots.ctypes.data_as(i64p), cr_slots.ctypes.data_as(i64p),
+            amt_lo.ctypes.data_as(u64p), amt_hi.ctypes.data_as(u64p),
+            pend.ctypes.data_as(u8p), maybe.ctypes.data_as(u8p),
+        )
+        if bits < 0:
+            return None
+        return (code, host_code, dr_slots, cr_slots, amt_lo, amt_hi,
+                pend, maybe, bits)
+
     def create_transfers(self, events: np.ndarray, timestamp: Optional[int] = None) -> np.ndarray:
         events = np.atleast_1d(events)
         n = len(events)
@@ -444,6 +555,10 @@ class StateMachine:
             timestamp = self.prepare("create_transfers", n)
         if n == 0:
             return np.zeros(0, dtype=types.EVENT_RESULT_DTYPE)
+
+        staged = self._ct_stage_native(events, timestamp)
+        if staged is not None:
+            return self._create_transfers_staged(events, timestamp, staged)
         ts = np.uint64(timestamp) - np.uint64(n) + 1 + np.arange(n, dtype=np.uint64)
 
         flags16 = events["flags"]
@@ -544,6 +659,17 @@ class StateMachine:
                 return self._create_transfers_exact(
                     events, ts, dr_slots, cr_slots, host_code, timestamp, is_pv, pv_keys
                 )
+        return self._commit_fast_device(
+            events, ts, dr_slots, cr_slots, host_code, timestamp
+        )
+
+    def _commit_fast_device(
+        self, events, ts, dr_slots, cr_slots, host_code, timestamp
+    ) -> np.ndarray:
+        """Shared tail of the device fast path (both the C-staged and the
+        numpy-staged dispatchers land here): pack, run the fast kernel,
+        bail to serial on overflow, store OK rows."""
+        n = len(events)
         b, host_code_p = self._device_batch(events, ts, dr_slots, cr_slots, host_code)
         with tracer.span("sm.create_transfers.fast"):
             new_state, codes_dev, bail = self._ops.create_transfers_fast(
@@ -563,6 +689,91 @@ class StateMachine:
             self._store_new_transfers(recs)
             self.commit_timestamp = int(ts[ok][-1])
         return _codes_to_results(codes)
+
+    def _create_transfers_staged(
+        self, events: np.ndarray, timestamp: int, staged
+    ) -> np.ndarray:
+        """Routing + commit from the C-staged batch (same decisions as the
+        numpy fallback path in create_transfers, same byte-exact results —
+        the staged ladder IS host_kernel.validate's merged ladder)."""
+        (code, host_code, dr_slots, cr_slots, amt_lo, amt_hi,
+         pend_u8, maybe_u8, bits) = staged
+        n = len(events)
+        ts = np.uint64(timestamp) - np.uint64(n) + 1 + np.arange(n, dtype=np.uint64)
+
+        hard = bool(bits & 1)  # duplicate ids within the batch
+        if not hard and (bits & 4):
+            # Bloom hits: stored ids (or ~2% false positives) — confirm
+            # against the durable index for just the flagged keys.
+            with tracer.span("sm.ct.dupcheck"):
+                m = maybe_u8.astype(bool)
+                hard = self.transfer_index.contains_any(
+                    pack_keys(events["id_lo"][m], events["id_hi"][m])
+                )
+        pv_keys = None
+        is_pv = None
+        if not hard and (bits & 8):
+            # post/void of a pending created in this same batch → serial.
+            flags16 = events["flags"]
+            is_pv = (flags16 & _PV_FLAGS) != 0
+            keys = pack_keys(events["id_lo"], events["id_hi"])
+            sorted_ids = keys[np.lexsort((keys["hi"], keys["lo"]))]
+            pv_keys = pack_keys(
+                events["pending_id_lo"][is_pv], events["pending_id_hi"][is_pv]
+            )
+            hit = np.full(len(pv_keys), NOT_FOUND, dtype=np.uint32)
+            search_run(
+                sorted_ids, np.zeros(n, dtype=np.uint32), pv_keys,
+                hit, np.ones(len(pv_keys), dtype=bool),
+            )
+            hard = bool(np.any(hit == 0))
+        if hard:
+            self.stats["serial_batches"] += 1
+            with tracer.span("sm.create_transfers.serial"):
+                return self._create_transfers_serial(events, timestamp)
+
+        exact_needed = bool(bits & 2)
+        if exact_needed and self._ops is None:
+            self.stats["serial_batches"] += 1
+            return self._create_transfers_serial(events, timestamp)
+
+        if self._ops is not None:
+            if exact_needed:
+                if is_pv is None:
+                    is_pv = (events["flags"] & _PV_FLAGS) != 0
+                with tracer.span("sm.create_transfers.exact"):
+                    return self._create_transfers_exact(
+                        events, ts, dr_slots, cr_slots, host_code,
+                        timestamp, is_pv, pv_keys,
+                    )
+            return self._commit_fast_device(
+                events, ts, dr_slots, cr_slots, host_code, timestamp
+            )
+
+        # numpy fast path: the staged merged ladder IS the validation result.
+        from tigerbeetle_tpu.models import host_kernel
+
+        ok = code == 0
+        pend = pend_u8.astype(bool)
+        with tracer.span("sm.ct.post"):
+            overflow = host_kernel.post(
+                self._host_bal, dr_slots, cr_slots, amt_lo, amt_hi,
+                ok & pend, ok & ~pend,
+            )
+        if overflow:
+            self.stats["bail_batches"] += 1
+            return self._create_transfers_serial(events, timestamp)
+        self.stats["fast_batches"] += 1
+        if np.any(ok):
+            with tracer.span("sm.ct.store"):
+                if ok.all():
+                    self._store_new_transfers(events, ts=ts)
+                else:
+                    recs = events[ok].copy()
+                    recs["timestamp"] = ts[ok]
+                    self._store_new_transfers(recs)
+            self.commit_timestamp = int(ts[ok][-1])
+        return _codes_to_results(code)
 
     def _device_batch(self, events, ts, dr_slots, cr_slots, host_code):
         """Pack events into the kernel's SoA form, padded to a power-of-two

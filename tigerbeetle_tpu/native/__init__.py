@@ -110,6 +110,46 @@ def hostops() -> Optional[ctypes.CDLL]:
         i64p, i64p, u64p, u64p, u8p, u8p,
     ]
     lib.hostops_post_u128.restype = ctypes.c_int
+    lib.hostops_ct_stage.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,  # events, n, stride
+        ctypes.c_uint64,                                   # ts_base
+        ctypes.c_void_p,                                   # account map
+        u32p, u32p,                                        # acc_ledger, acc_flags
+        u64p, ctypes.c_uint64,                             # bloom words, mask
+        u32p, u32p, i64p, i64p, u64p, u64p, u8p, u8p,
+    ]
+    lib.hostops_ct_stage.restype = ctypes.c_int
+    lib.hostops_build_sorted_kv.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_uint32, ctypes.c_char_p, u32p,
+    ]
+    lib.hostops_build_sorted_kv.restype = ctypes.c_int
+    lib.hostops_extract_kv.argtypes = lib.hostops_build_sorted_kv.argtypes
+    lib.hostops_extract_kv.restype = ctypes.c_int
+    # The C staging ladder hardcodes the wire-contract result codes; refuse
+    # the shim (fall back to numpy) if the enums ever drift.
+    from tigerbeetle_tpu.results import CreateTransferResult as _TR
+
+    _expect = {
+        "TIMESTAMP_MUST_BE_ZERO": 3, "RESERVED_FLAG": 4,
+        "ID_MUST_NOT_BE_ZERO": 5, "ID_MUST_NOT_BE_INT_MAX": 6,
+        "DEBIT_ACCOUNT_ID_MUST_NOT_BE_ZERO": 8,
+        "DEBIT_ACCOUNT_ID_MUST_NOT_BE_INT_MAX": 9,
+        "CREDIT_ACCOUNT_ID_MUST_NOT_BE_ZERO": 10,
+        "CREDIT_ACCOUNT_ID_MUST_NOT_BE_INT_MAX": 11,
+        "ACCOUNTS_MUST_BE_DIFFERENT": 12, "PENDING_ID_MUST_BE_ZERO": 13,
+        "TIMEOUT_RESERVED_FOR_PENDING_TRANSFER": 17,
+        "AMOUNT_MUST_NOT_BE_ZERO": 18, "LEDGER_MUST_NOT_BE_ZERO": 19,
+        "CODE_MUST_NOT_BE_ZERO": 20, "DEBIT_ACCOUNT_NOT_FOUND": 21,
+        "CREDIT_ACCOUNT_NOT_FOUND": 22,
+        "ACCOUNTS_MUST_HAVE_THE_SAME_LEDGER": 23,
+        "TRANSFER_MUST_HAVE_THE_SAME_LEDGER_AS_ACCOUNTS": 24,
+        "OVERFLOWS_TIMEOUT": 53,
+    }
+    for name, val in _expect.items():
+        if int(getattr(_TR, name)) != val:
+            return None
     _hostops = lib
     return _hostops
 
@@ -161,3 +201,21 @@ def aegis128l_mac() -> Optional[Callable[[bytes], bytes]]:
         return None
     _mac = mac
     return _mac
+
+
+def aegis128l_mac_ptr() -> Optional[Callable[[int, int], bytes]]:
+    """(address, nbytes) -> 16-byte tag over raw memory — the zero-copy
+    sibling of aegis128l_mac for numpy-array bodies."""
+    if aegis128l_mac() is None:
+        return None
+    lib = ctypes.CDLL(_LIB)
+    fn = lib.aegis128l_mac
+    fn.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p]
+    fn.restype = None
+
+    def mac_ptr(addr: int, size: int) -> bytes:
+        out = ctypes.create_string_buffer(16)
+        fn(addr, size, out)
+        return out.raw
+
+    return mac_ptr

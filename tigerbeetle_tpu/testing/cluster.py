@@ -23,22 +23,6 @@ from tigerbeetle_tpu.vsr.header import Command, Message, Operation
 from tigerbeetle_tpu.vsr.replica import Replica
 
 
-class MemSnapshotStore:
-    """Op-tagged snapshots; only synced entries survive a crash()."""
-
-    def __init__(self) -> None:
-        self._blobs: Dict[int, bytes] = {}
-
-    def save(self, op: int, blob: bytes) -> None:
-        self._blobs[op] = blob
-
-    def load(self, op: int) -> Optional[bytes]:
-        return self._blobs.get(op)
-
-    def prune(self, keep_op: int) -> None:
-        self._blobs = {op: b for op, b in self._blobs.items() if op == keep_op}
-
-
 class PacketSimulator:
     """Seeded virtual network: delay, loss, duplication, partitions."""
 
@@ -211,7 +195,6 @@ class Cluster:
             MemStorage(self.zone.total_size, seed=seed * 97 + i)
             for i in range(replica_count)
         ]
-        self.snapshots = [MemSnapshotStore() for _ in range(replica_count)]
         self.replicas: List[Optional[Replica]] = [None] * replica_count
         self.sm_backend = sm_backend
         for i in range(replica_count):
@@ -230,7 +213,6 @@ class Cluster:
             zone=self.zone,
             config=self.config,
             bus=_ReplicaBus(self.net, i),
-            snapshot_store=self.snapshots[i],
             sm_backend=self.sm_backend,
         )
         r.open()
@@ -311,14 +293,17 @@ class Cluster:
         # client TABLE rows (replica-independent) — must be byte-identical.
         skip = {
             "client_replies",
-            "log_blocks", "log_tail", "ti_manifest", "ai_manifest", "free_set",
+            "log_blocks", "log_tail", "ti_manifest", "ai_manifest",
+            "ti_fences", "ti_fence_counts", "ai_fences", "ai_fence_counts",
+            "free_set",
         }
         sections = {}
         for i in at_top:
-            blob = self.snapshots[i].load(top)
-            assert blob is not None, (
-                f"replica {i} advertises checkpoint {top} without a blob"
-            )
+            # Grid-resident checkpoints: the blob is read back from the
+            # replica's own data file via its trailer reference (ONE data
+            # file — the checker sees exactly what a restart would load).
+            r = self.replicas[i]
+            blob = r._trailer_read(r.superblock.state.trailer_block)
             with np.load(io.BytesIO(blob)) as z:
                 sections[i] = {k: z[k] for k in z.files if k not in skip}
         base_i = at_top[0]

@@ -64,7 +64,22 @@ class GroupSync:
                 if self._stopped and not self._pending:
                     return
                 batch, self._pending = self._pending, []
-            self._storage.sync()
+            try:
+                self._storage.sync()
+            except Exception as e:  # noqa: BLE001 — fail-stop, never wedge
+                # A failed WAL fsync means acks can never be granted again:
+                # post a poison callback so the event loop fail-stops loudly
+                # (silently dying here would wedge the replica — no acks,
+                # no crash, no log line).
+                err = e
+
+                def _poison() -> None:
+                    raise RuntimeError(f"WAL group fsync failed: {err!r}") from err
+
+                self._post(_poison)
+                with self._cond:
+                    self._stopped = True
+                return
             for cb in batch:
                 self._post(cb)
 

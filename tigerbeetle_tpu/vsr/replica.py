@@ -38,7 +38,7 @@ from tigerbeetle_tpu.vsr import snapshot
 from tigerbeetle_tpu.vsr.clock import Clock, DeterministicTime
 from tigerbeetle_tpu.vsr.header import Command, Header, Message, Operation
 from tigerbeetle_tpu.vsr.journal import Journal
-from tigerbeetle_tpu.vsr.superblock import SuperBlock, VSRState
+from tigerbeetle_tpu.vsr.superblock import NO_TRAILER, SuperBlock, VSRState
 
 STATUS_NORMAL = "normal"
 STATUS_VIEW_CHANGE = "view_change"
@@ -105,7 +105,6 @@ class Replica:
         zone: Zone,
         config: Config,
         bus,
-        snapshot_store=None,
         sm_backend: str = "numpy",
         on_event: Optional[Callable[[str, "Replica"], None]] = None,
         time=None,
@@ -118,8 +117,10 @@ class Replica:
         self.storage = storage
         self.zone = zone
         self.bus = bus
-        self.snapshot_store = snapshot_store
         self.sm_backend = sm_backend
+        # Grid blocks of the current checkpoint trailer (index block first);
+        # stage-released when the next checkpoint supersedes them.
+        self._trailer_blocks: List[int] = []
         # Optional append-only file of committed prepares (vsr/aof.py;
         # reference hook at replica.zig:3745).
         self.aof = aof
@@ -258,13 +259,16 @@ class Replica:
         self.commit_max = max(st.commit_max, st.op_checkpoint)
         self.checksum_floor = st.op_checkpoint
 
-        if self.snapshot_store is not None and st.op_checkpoint > 0:
-            # Load the snapshot for EXACTLY the superblock's checkpoint op —
-            # a newer snapshot may exist if we crashed between snapshot save
-            # and superblock write; it must be ignored (stale-future).
-            blob = self.snapshot_store.load(st.op_checkpoint)
-            assert blob is not None, "superblock references a checkpoint; snapshot missing"
-            self._load_snapshot(blob)
+        if st.op_checkpoint > 0:
+            # Load the checkpoint trailer the superblock references — by
+            # construction EXACTLY the durable checkpoint's state (a newer
+            # trailer written by a crash between trailer write and
+            # superblock advance occupies unreferenced blocks and is
+            # simply never read: stale-future safety by pointer identity).
+            assert st.trailer_block != NO_TRAILER, (
+                "superblock references a checkpoint but carries no trailer"
+            )
+            self._load_snapshot(self._trailer_read(st.trailer_block))
 
         self.journal.recover(self.cluster)
         self.journal.flush_dirty()
@@ -639,8 +643,16 @@ class Replica:
             existing = self.journal.read_prepare(op)
             if existing is not None and existing.header["checksum"] == h["checksum"]:
                 self._drop_target(op)
-                self._send_prepare_ok(h)
-                self._commit_journal(h["commit"])
+                # Ack-after-durable even for duplicates: with group commit
+                # the original write may still sit in the page cache (only
+                # the batched fdatasync makes it durable) — acking from the
+                # page-cache read would let the primary count a quorum an
+                # untimely power loss could revoke.
+                if self.wal_group is None:
+                    self._send_prepare_ok(h)
+                    self._commit_journal(h["commit"])
+                else:
+                    self.wal_group.request(lambda: self._backup_wal_durable(h))
                 return
             if (existing is None or h["view"] >= existing.header["view"]) and (
                 self.journal.can_write(op)
@@ -965,7 +977,7 @@ class Replica:
         # chunked transfer: the first chunk announces (count, size, whole-
         # blob checksum); the requester pulls the rest.
         st = self.superblock.state
-        if op <= st.op_checkpoint and self.snapshot_store is not None:
+        if op <= st.op_checkpoint and st.op_checkpoint > 0:
             self._send_sync_chunk(msg.header["replica"], 0)
 
     # --- state sync (chunked; reference sync.zig + docs/internals/sync.md) -
@@ -975,14 +987,15 @@ class Replica:
     def _sync_blob(self) -> Optional[tuple]:
         """(checkpoint_op, blob, whole-blob checksum), cached per checkpoint."""
         st = self.superblock.state
-        if self.snapshot_store is None or st.op_checkpoint == 0:
+        if st.op_checkpoint == 0 or st.trailer_block == NO_TRAILER:
             return None
         cached = self._sync_serve_cache
         if cached is not None and cached[0] == st.op_checkpoint:
             return cached
-        blob = self.snapshot_store.load(st.op_checkpoint)
-        if blob is None:
-            return None
+        try:
+            blob = self._trailer_read(st.trailer_block)
+        except IOError:
+            return None  # local trailer corrupt — cannot serve sync
         # Local blobs reference OUR grid blocks; peers need the transfers
         # materialized (grid-block sync is a later round).
         export = snapshot.to_export(self, blob)
@@ -1133,20 +1146,22 @@ class Replica:
         st.commit_max = max(st.commit_max, sync_op)
         # Persist OUR OWN local-mode checkpoint of the installed state (the
         # export blob references no grid blocks and would force a full LSM
-        # rebuild on restart), make its blocks durable, then advance the
-        # superblock.
-        local_blob = self._save_snapshot()
-        if self.snapshot_store is not None:
-            self.snapshot_store.save(sync_op, local_blob)
+        # rebuild on restart) as a grid trailer, make its blocks durable,
+        # then advance the superblock. _trailer_write allocates from the
+        # install free set, which still holds every pre-sync block
+        # allocated — the rollback state stays intact until the superblock
+        # lands.
+        st.trailer_block = self._trailer_write()
         self.storage.sync()
         self.superblock.checkpoint()
         # New checkpoint durable: reclaim everything it does not reference
-        # (the old checkpoint's and pre-sync live blocks).
-        fs_bytes = snapshot.free_set_bytes(local_blob)
-        if fs_bytes is not None:
-            grid.free_set.restore(fs_bytes)
-        if self.snapshot_store is not None:
-            self.snapshot_store.prune(keep_op=sync_op)
+        # (the old checkpoint's and pre-sync live blocks). The trailer's
+        # encoded free set is references-exact (snapshot.referenced_blocks),
+        # so restoring it drops every stale pre-sync allocation the install
+        # free set was still carrying.
+        fs = snapshot.free_set_bytes(self._trailer_read(st.trailer_block))
+        assert fs is not None
+        grid.free_set.restore(fs)
         self._sync_serve_cache = None
         self.on_event("sync", self)
         self._commit_journal(self.commit_max)
@@ -1503,7 +1518,11 @@ class Replica:
         results: bytes
 
         if operation >= 128:
-            events = np.frombuffer(bytearray(body), dtype=_event_dtype(operation))
+            # Read-only view straight over the wire bytes — the state
+            # machine never mutates event arrays (failing rows are copied
+            # before stamping), and the old bytearray round-trip copied
+            # every 1 MiB body once per commit.
+            events = np.frombuffer(body, dtype=_event_dtype(operation))
             if operation == Operation.CREATE_ACCOUNTS:
                 res = sm.create_accounts(events, timestamp=h["timestamp"])
                 sm.prepare_timestamp = max(sm.prepare_timestamp, h["timestamp"])
@@ -1615,15 +1634,20 @@ class Replica:
             return
         if self.commit_min <= self.superblock.state.op_checkpoint:
             return
+        if self.grid is None:
+            # No durable grid zone (journal-only fixture): a trailer written
+            # to the in-memory grid would not survive restart — advancing
+            # the superblock past state we cannot reload would brick open().
+            return
         log.info("replica %d: checkpoint at op %d", self.replica, self.commit_min)
         tracer.count("replica.checkpoint")
         if self.aof is not None:
             self.aof.sync()
-        if self.snapshot_store is not None:
-            # encode() flushes LSM memtables into grid blocks; those blocks
-            # must be durable before the superblock may reference them.
-            self.snapshot_store.save(self.commit_min, self._save_snapshot())
-            self.storage.sync()
+        # Trailer write flushes LSM memtables into grid blocks and chunks
+        # the checkpoint blob into reserved blocks; everything must be
+        # durable before the superblock may reference it.
+        trailer_block = self._trailer_write()
+        self.storage.sync()
         st = self.superblock.state
         st.op_checkpoint = self.commit_min
         st.commit_min = self.commit_min
@@ -1632,13 +1656,12 @@ class Replica:
         st.log_view = self.log_view
         st.prepare_timestamp = self.committed_timestamp_max
         st.commit_timestamp = self.state_machine.commit_timestamp
+        st.trailer_block = trailer_block
         self.superblock.checkpoint()
         # The checkpoint is durable: staged grid frees (tables replaced by
-        # compaction since the last checkpoint) may now be reused, and
-        # older snapshots may go.
+        # compaction since the last checkpoint, plus the previous trailer's
+        # blocks) may now be reused.
         self.state_machine.grid.commit_releases()
-        if self.snapshot_store is not None:
-            self.snapshot_store.prune(keep_op=self.commit_min)
         self.on_event("checkpoint", self)
 
     def _save_snapshot(self) -> bytes:
@@ -1647,3 +1670,86 @@ class Replica:
     def _load_snapshot(self, blob: bytes) -> None:
         tracer.count("mark.state_sync_install")
         snapshot.install(self, blob)
+
+    # --- checkpoint trailer (grid-resident checkpoint state) ------------
+    #
+    # The checkpoint blob lives in grid blocks referenced from the
+    # superblock (reference checkpoint_trailer.zig:459): chunks in data
+    # blocks + one index block listing them. ONE data file — no side
+    # files. Crash discipline: the previous trailer's blocks are only
+    # STAGE-released (freed after the new superblock is durable), and the
+    # new trailer occupies freshly acquired blocks, so a crash on either
+    # side of the superblock write recovers to a complete trailer.
+
+    BLOCK_TYPE_TRAILER = 4
+    _TRAILER_HEAD = np.dtype(
+        [("count", "<u4"), ("_pad", "<u4"), ("blob_len", "<u8"),
+         ("cks_lo", "<u8"), ("cks_hi", "<u8")]
+    )
+
+    def _trailer_write(self) -> int:
+        """Encode the checkpoint blob into reserved grid blocks; returns
+        the trailer index block. Converges on the reservation size (the
+        encoded free set accounts the trailer's own blocks, which feeds
+        back into the blob length)."""
+        grid = self.state_machine.grid
+        payload_max = grid.payload_max
+        fences_max = (payload_max - self._TRAILER_HEAD.itemsize) // 4
+        # Stage-release the previous trailer (reclaimed post-durability).
+        for b in self._trailer_blocks:
+            grid.release(b)
+        reserved: List[int] = []
+        blob = b""
+        for _ in range(8):
+            blob = snapshot.encode(self, trailer_blocks=reserved)
+            need = -(-len(blob) // payload_max) + 1  # chunks + index block
+            assert need - 1 <= fences_max, "checkpoint trailer exceeds one index block"
+            if need == len(reserved):
+                break
+            while len(reserved) < need:
+                reserved.append(grid.free_set.acquire())
+            while len(reserved) > need:
+                grid.free_set.release(reserved.pop())
+        else:
+            raise RuntimeError("checkpoint trailer reservation did not converge")
+        index_block, chunks = reserved[0], reserved[1:]
+        for i, b in enumerate(chunks):
+            grid.write_block_at(
+                b, blob[i * payload_max : (i + 1) * payload_max],
+                self.BLOCK_TYPE_TRAILER,
+            )
+        head = np.zeros((), dtype=self._TRAILER_HEAD)
+        head["count"] = len(chunks)
+        head["blob_len"] = len(blob)
+        c = hdr.checksum(blob)
+        head["cks_lo"] = c & ((1 << 64) - 1)
+        head["cks_hi"] = c >> 64
+        grid.write_block_at(
+            index_block,
+            head.tobytes() + np.array(chunks, dtype=np.uint32).tobytes(),
+            self.BLOCK_TYPE_TRAILER,
+        )
+        self._trailer_blocks = reserved
+        return index_block
+
+    def _trailer_read(self, index_block: int) -> bytes:
+        """Read the checkpoint blob back from its trailer blocks; also
+        records the trailer block set (so the next checkpoint can
+        stage-release it)."""
+        grid = self.state_machine.grid
+        payload = grid.read_block(index_block)
+        head = np.frombuffer(
+            payload[: self._TRAILER_HEAD.itemsize], dtype=self._TRAILER_HEAD
+        )[0]
+        count = int(head["count"])
+        chunks = np.frombuffer(
+            payload[self._TRAILER_HEAD.itemsize : self._TRAILER_HEAD.itemsize + 4 * count],
+            dtype=np.uint32,
+        )
+        blob = b"".join(grid.read_block(int(b)) for b in chunks)
+        blob = blob[: int(head["blob_len"])]
+        want = int(head["cks_lo"]) | (int(head["cks_hi"]) << 64)
+        if len(blob) != int(head["blob_len"]) or hdr.checksum(blob) != want:
+            raise IOError("checkpoint trailer corrupt")
+        self._trailer_blocks = [index_block] + [int(b) for b in chunks]
+        return blob

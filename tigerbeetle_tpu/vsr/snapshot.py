@@ -98,12 +98,36 @@ def history_from_array(arr: np.ndarray) -> List:
     return out
 
 
-def encode(replica, mode: str = "local") -> bytes:
+def referenced_blocks(sm, tree_fences, extra=()) -> np.ndarray:
+    """Every grid block the checkpoint references: object-log blocks, each
+    LSM table's index block + data blocks (from `tree_fences`, the fence
+    arrays encode() already computed per tree), plus `extra` (the
+    checkpoint trailer's own reserved blocks). The encoded free set is
+    derived from THIS — references-exact by construction, so it is
+    byte-deterministic across replicas and immune to allocation-history
+    skew (e.g. a synced replica whose live bitset still carries pre-sync
+    allocations)."""
+    free = np.ones(sm.grid.block_count, dtype=bool)
+    blocks = list(sm.transfer_log.blocks)
+    for tree, fences in zip((sm.transfer_index, sm.account_rows), tree_fences):
+        for level in tree.levels:
+            for t in level:
+                blocks.append(t.index_block)
+        blocks.extend(fences["block"].tolist())
+    blocks.extend(extra)
+    if blocks:
+        free[np.array(blocks, dtype=np.int64)] = False
+    return free
+
+
+def encode(replica, mode: str = "local", trailer_blocks=()) -> bytes:
     """Serialize the replica's replicated state at its current commit point.
 
     mode="local": the checkpoint blob for THIS replica's own recovery —
     transfers stay in the grid; the blob carries only the LSM manifests,
     the log's block list + tail, and the EWAH free set (small, O(tables)).
+    `trailer_blocks` are the grid blocks reserved for the checkpoint
+    trailer itself — accounted allocated in the encoded free set.
     mode="export": a self-contained blob for state sync to a peer whose
     grid differs — transfers are materialized in full (grid-block sync is
     a later round; reference request_blocks/on_block, replica.zig:2289).
@@ -149,10 +173,19 @@ def encode(replica, mode: str = "local") -> bytes:
         log_blocks, log_tail = sm.transfer_log.checkpoint()
         sections["ti_manifest"] = sm.transfer_index.checkpoint()
         sections["ai_manifest"] = sm.account_rows.checkpoint()
+        ti_fences, ti_counts = sm.transfer_index.checkpoint_fences()
+        ai_fences, ai_counts = sm.account_rows.checkpoint_fences()
+        sections["ti_fences"], sections["ti_fence_counts"] = ti_fences, ti_counts
+        sections["ai_fences"], sections["ai_fence_counts"] = ai_fences, ai_counts
         sections["log_blocks"] = log_blocks
         sections["log_tail"] = log_tail
+        from tigerbeetle_tpu.io import ewah
+
         sections["free_set"] = np.frombuffer(
-            sm.grid.free_set.encode(), dtype=np.uint8
+            ewah.encode(ewah.bitset_to_words(
+                referenced_blocks(sm, (ti_fences, ai_fences), extra=trailer_blocks)
+            )),
+            dtype=np.uint8,
         )
 
     buf = _io.BytesIO()
@@ -173,7 +206,10 @@ def to_export(replica, local_blob: bytes) -> bytes:
 
     log = DurableLog(replica.state_machine.grid, types.TRANSFER_DTYPE)
     log.restore(z["log_blocks"], z["log_tail"])
-    skip = {"ti_manifest", "ai_manifest", "log_blocks", "log_tail", "free_set"}
+    skip = {
+        "ti_manifest", "ai_manifest", "ti_fences", "ti_fence_counts",
+        "ai_fences", "ai_fence_counts", "log_blocks", "log_tail", "free_set",
+    }
     sections = {k: z[k] for k in z.files if k not in skip}
     sections["transfers"] = log.export_all()
     buf = _io.BytesIO()
@@ -289,7 +325,9 @@ def install(replica, blob: bytes) -> None:
         sm.grid.free_set.restore(z["free_set"].tobytes())
         sm.grid.drop_cache()
         sm.transfer_index.restore(z["ti_manifest"])
+        sm.transfer_index.attach_fences(z["ti_fences"], z["ti_fence_counts"])
         sm.account_rows.restore(z["ai_manifest"])
+        sm.account_rows.attach_fences(z["ai_fences"], z["ai_fence_counts"])
         sm.transfer_log.restore(z["log_blocks"], z["log_tail"])
         # Rebuild the transfer-id Bloom pre-filter (RAM-only, no false
         # negatives allowed: every stored id must be re-added) by scanning

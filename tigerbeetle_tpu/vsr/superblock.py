@@ -40,10 +40,17 @@ SUPERBLOCK_DTYPE = np.dtype(
         ("prepare_timestamp", "<u8"),
         ("commit_timestamp", "<u8"),
         ("parent_lo", "<u8"), ("parent_hi", "<u8"),  # checkpoint id chain
-        ("reserved", "V384"),
+        # Grid block index of the checkpoint trailer's index block
+        # (reference checkpoint_trailer.zig: checkpoint state lives in grid
+        # blocks referenced from the superblock — ONE data file, no side
+        # files). NO_TRAILER when op_checkpoint == 0.
+        ("trailer_block", "<u4"),
+        ("reserved", "V380"),
     ]
 )
 assert SUPERBLOCK_DTYPE.itemsize == 512
+
+NO_TRAILER = 0xFFFFFFFF
 
 
 @dataclass
@@ -61,6 +68,7 @@ class VSRState:
     prepare_timestamp: int = 0
     commit_timestamp: int = 0
     parent: int = 0
+    trailer_block: int = 0xFFFFFFFF  # NO_TRAILER
     sequence: int = field(default=0)
 
 
@@ -90,6 +98,7 @@ class SuperBlock:
         rec["commit_timestamp"] = s.commit_timestamp
         rec["parent_lo"] = s.parent & ((1 << 64) - 1)
         rec["parent_hi"] = s.parent >> 64
+        rec["trailer_block"] = s.trailer_block
         c = checksum(rec.tobytes()[16:])
         rec["checksum_lo"] = c & ((1 << 64) - 1)
         rec["checksum_hi"] = c >> 64
@@ -116,6 +125,7 @@ class SuperBlock:
             prepare_timestamp=int(rec["prepare_timestamp"]),
             commit_timestamp=int(rec["commit_timestamp"]),
             parent=int(rec["parent_lo"]) | (int(rec["parent_hi"]) << 64),
+            trailer_block=int(rec["trailer_block"]),
             sequence=int(rec["sequence"]),
         )
 
