@@ -30,16 +30,18 @@ def setup_client(cluster, cid=100):
     return c
 
 
-def grow_state(cl, c, accounts=120, transfer_batches=28):
+def grow_state(cl, c, accounts=120, transfer_batches=28, id_base=1000,
+               make_accounts=True):
     """Commit enough distinct state to exceed several TEST_MIN frames."""
-    ids = list(range(1, accounts + 1))
-    for i in range(0, accounts, 20):
-        do_request(cl, c, Operation.CREATE_ACCOUNTS, account_batch(ids[i : i + 20]))
+    if make_accounts:
+        ids = list(range(1, accounts + 1))
+        for i in range(0, accounts, 20):
+            do_request(cl, c, Operation.CREATE_ACCOUNTS, account_batch(ids[i : i + 20]))
     for b in range(transfer_batches):
         do_request(
             cl, c, Operation.CREATE_TRANSFERS,
             transfer_batch([
-                dict(id=1000 + b * 20 + k, debit_account_id=1 + (k % accounts),
+                dict(id=id_base + b * 20 + k, debit_account_id=1 + (k % accounts),
                      credit_account_id=1 + ((k + 1) % accounts), amount=1 + k,
                      ledger=1, code=1)
                 for k in range(20)
@@ -210,3 +212,62 @@ class TestChunkedSync:
             np.array([1], dtype=np.uint64), np.array([0], dtype=np.uint64)
         )
         assert len(out) == 1
+
+    def test_block_sync_traffic_proportional_to_delta(self):
+        """A lagging replica whose grid already holds most of the state
+        (it crashed with synced storage, then the cluster ran on past the
+        WAL ring) fetches ONLY the blocks that changed — the reference's
+        request_blocks/on_block delta property (replica.zig:2289,2413).
+        A replica with an EMPTY grid fetches everything."""
+        cl = Cluster(replica_count=3, seed=37)
+        c = setup_client(cl)
+        # Build up durable state + cross a checkpoint so the backup's grid
+        # holds a real prefix of the cluster's blocks.
+        grow_state(cl, c, accounts=120, transfer_batches=20)
+        live0 = [r for r in cl.replicas if r is not None]
+        assert all(r.superblock.state.op_checkpoint >= 16 for r in live0)
+        backup = next(r for r in cl.replicas if not r.is_primary)
+        bi = backup.replica
+        cl.storages[bi].sync()
+        cl.crash_replica(bi)
+        # Advance well past the WAL ring with MORE state (two more
+        # checkpoints' worth) so the backup must state-sync on rejoin.
+        grow_state(cl, c, accounts=120, transfer_batches=30,
+                   id_base=100_000, make_accounts=False)
+        cl.restart_replica(bi)
+        target = max(r.commit_min for r in cl.replicas if r is not None)
+        cl.run_until(
+            lambda: cl.replicas[bi].commit_min >= target, max_ticks=200_000
+        )
+        rb = cl.replicas[bi]
+        stats = rb.block_sync_stats
+        assert stats["wanted"] > 0
+        # Delta property: a meaningful share of the referenced set was
+        # already present locally and was NOT transferred. (TEST_MIN
+        # geometry is tiny — compaction rewrites most table blocks between
+        # checkpoints — so the retained share here is mostly the stable
+        # prefix of the object log; at production geometry the retained
+        # share grows with history.)
+        retained = stats["wanted"] - stats["missing"]
+        assert retained >= 10, stats
+        assert stats["missing"] < stats["wanted"], stats
+        cl.check_state_convergence()
+
+    def test_block_sync_from_empty_grid_fetches_all(self):
+        cl, bi, c = self._lagging_backup_cluster()
+        # Wipe the backup's storage wholesale: rejoin must fetch every
+        # referenced block (and still converge).
+        from tigerbeetle_tpu.io.storage import MemStorage
+        from tigerbeetle_tpu.vsr.replica import Replica
+
+        cl.storages[bi] = MemStorage(cl.zone.total_size, seed=999)
+        Replica.format(cl.storages[bi], cl.zone, cl.cluster_id, bi, 3)
+        cl.restart_replica(bi)
+        target = max(r.commit_min for r in cl.replicas if r is not None)
+        cl.run_until(
+            lambda: cl.replicas[bi].commit_min >= target, max_ticks=200_000
+        )
+        rb = cl.replicas[bi]
+        stats = rb.block_sync_stats
+        assert stats["missing"] == stats["wanted"] > 0, stats
+        cl.check_state_convergence()

@@ -41,42 +41,55 @@ assert _BLOCK_HEADER_DTYPE.itemsize == BLOCK_HEADER_SIZE
 class FreeSet:
     """Bitset allocator for grid blocks (reference free_set.zig).
 
-    True = free. Acquisition scans from a cursor for determinism (the
-    reference's reserve/acquire protocol collapses to sequential acquire in
-    a single-writer host runtime).
+    True = free. Content acquisition always takes the LOWEST free block —
+    restart-invariant by construction: any two replicas whose free bits
+    agree and who run the same operation sequence allocate identical
+    indices, so checkpointed grid layout is byte-deterministic across the
+    cluster (the storage checker compares it unconditionally). Checkpoint
+    trailers allocate from the TOP (`acquire_high`) so their per-replica
+    placement history never perturbs content layout.
     """
 
     def __init__(self, block_count: int) -> None:
         self.free = np.ones(block_count, dtype=bool)
-        self._cursor = 0
         # Frees staged until the next checkpoint commits (write-once per
         # checkpoint epoch): blocks referenced by the last durable
         # checkpoint must not be reused before a newer checkpoint lands,
         # or crash recovery could rewind to a manifest whose blocks were
         # overwritten.
         self._staged: list[int] = []
+        # Amortization hint: every index < _low is known-allocated, so
+        # acquire scans from here instead of 0 (identical result sequence;
+        # release/restore rewind it). Without this, lowest-free-first costs
+        # O(block_count) per acquisition on a mostly-full grid.
+        self._low = 0
 
     @property
     def free_count(self) -> int:
         return int(self.free.sum())
 
     def acquire(self) -> int:
-        n = len(self.free)
-        ix = np.argmax(self.free[self._cursor :])
-        if self.free[self._cursor + ix]:
-            got = self._cursor + int(ix)
-        else:
-            ix = np.argmax(self.free)
-            if not self.free[ix]:
-                raise RuntimeError("grid full: no free blocks")
-            got = int(ix)
-        self.free[got] = False
-        self._cursor = got + 1 if got + 1 < n else 0
-        return got
+        off = int(np.argmax(self.free[self._low :]))
+        ix = self._low + off
+        if ix >= len(self.free) or not self.free[ix]:
+            raise RuntimeError("grid full: no free blocks")
+        self.free[ix] = False
+        self._low = ix + 1
+        return ix
+
+    def acquire_high(self) -> int:
+        """Highest free block (checkpoint-trailer region)."""
+        rev = int(np.argmax(self.free[::-1]))
+        ix = len(self.free) - 1 - rev
+        if not self.free[ix]:
+            raise RuntimeError("grid full: no free blocks")
+        self.free[ix] = False
+        return ix
 
     def release(self, index: int) -> None:
         assert not self.free[index], f"double release of block {index}"
         self.free[index] = True
+        self._low = min(self._low, index)
 
     def stage_release(self, index: int) -> None:
         assert not self.free[index], f"double release of block {index}"
@@ -87,6 +100,7 @@ class FreeSet:
         is durable."""
         for i in self._staged:
             self.free[i] = True
+            self._low = min(self._low, i)
         self._staged = []
 
     def encode(self) -> bytes:
@@ -102,7 +116,7 @@ class FreeSet:
         words = ewah.decode(data, -(-n // ewah.WORD_BITS))
         self.free = ewah.words_to_bitset(words, n)
         self._staged = []
-        self._cursor = 0
+        self._low = 0
 
 
 class Grid:
@@ -133,6 +147,11 @@ class Grid:
         self.free_set = FreeSet(block_count)
         self._cache: OrderedDict[int, bytes] = OrderedDict()
         self._cache_blocks = cache_blocks
+        # RAM map of each written block's payload checksum — the identity
+        # side of block-level state sync (a checkpoint publishes
+        # (index, checksum) pairs; peers fetch only blocks whose local
+        # checksum differs). Restored from the checkpoint blob at open.
+        self.block_cks: dict[int, int] = {}
         self.reads = 0
         self.writes = 0
         self.cache_hits = 0
@@ -163,6 +182,7 @@ class Grid:
         head["checksum_hi"] = c >> 64
         self.storage.write(self._addr(index), head.tobytes() + payload)
         self.writes += 1
+        self.block_cks[index] = c
         self._cache_put(index, bytes(payload))
         return index
 
@@ -180,6 +200,7 @@ class Grid:
         head["checksum_hi"] = c >> 64
         self.storage.write(self._addr(index), head.tobytes() + payload)
         self.writes += 1
+        self.block_cks[index] = c
         self._cache_put(index, bytes(payload))
 
     def read_block(self, index: int) -> bytes:
@@ -199,6 +220,37 @@ class Grid:
             raise IOError(f"grid block {index} corrupt")
         self._cache_put(index, payload)
         return payload
+
+    def read_block_typed(self, index: int) -> tuple[bytes, int]:
+        """(payload, block_type) — the serve side of block-level sync
+        needs the stored type so the receiver can rewrite the block
+        byte-identically."""
+        raw = self.storage.read(self._addr(index), self.block_size)
+        head = np.frombuffer(raw[:BLOCK_HEADER_SIZE], dtype=_BLOCK_HEADER_DTYPE)[0]
+        size = int(head["size"])
+        payload = raw[BLOCK_HEADER_SIZE : BLOCK_HEADER_SIZE + size]
+        want = int(head["checksum_lo"]) | (int(head["checksum_hi"]) << 64)
+        if size > self.payload_max or _checksum(payload) != want:
+            raise IOError(f"grid block {index} corrupt")
+        return payload, int(head["block_type"])
+
+    def local_checksum(self, index: int) -> Optional[int]:
+        """The payload checksum of the block currently stored at `index`,
+        or None if the block is torn/corrupt/empty. Reads through to disk
+        (sync verification must see what a restart would)."""
+        try:
+            raw = self.storage.read(self._addr(index), self.block_size)
+        except OSError:
+            return None
+        head = np.frombuffer(raw[:BLOCK_HEADER_SIZE], dtype=_BLOCK_HEADER_DTYPE)[0]
+        size = int(head["size"])
+        if size > self.payload_max:
+            return None
+        payload = raw[BLOCK_HEADER_SIZE : BLOCK_HEADER_SIZE + size]
+        want = int(head["checksum_lo"]) | (int(head["checksum_hi"]) << 64)
+        if _checksum(payload) != want:
+            return None
+        return want
 
     def release(self, index: int) -> None:
         if self.defer_releases:

@@ -133,8 +133,8 @@ class DurableLog:
                 yield base + lo, recs[lo:hi]
 
     def export_all(self) -> np.ndarray:
-        """Whole-log materialization — ONLY for state-sync export (bounded
-        use: serialized then discarded). Not part of the query path."""
+        """Whole-log materialization — test/tooling helper only (state
+        sync is block-level since round 4). Not part of the query path."""
         parts = [recs for _, recs in self.scan_range(0, self.count)]
         if not parts:
             return np.zeros(0, dtype=self.dtype)

@@ -751,10 +751,21 @@ class StateMachine:
             )
 
         # numpy fast path: the staged merged ladder IS the validation result.
+        return self._commit_fast_numpy(
+            events, ts, code, dr_slots, cr_slots, amt_lo, amt_hi,
+            pend_u8.astype(bool), timestamp,
+        )
+
+    def _commit_fast_numpy(
+        self, events, ts, codes, dr_slots, cr_slots, amt_lo, amt_hi, pend,
+        timestamp,
+    ) -> np.ndarray:
+        """Shared tail of the numpy fast path (C-staged and numpy-staged
+        dispatchers): exact u128 posting, bail to serial on overflow,
+        store OK rows."""
         from tigerbeetle_tpu.models import host_kernel
 
-        ok = code == 0
-        pend = pend_u8.astype(bool)
+        ok = codes == 0
         with tracer.span("sm.ct.post"):
             overflow = host_kernel.post(
                 self._host_bal, dr_slots, cr_slots, amt_lo, amt_hi,
@@ -767,13 +778,15 @@ class StateMachine:
         if np.any(ok):
             with tracer.span("sm.ct.store"):
                 if ok.all():
+                    # Zero-copy: the log's append stamps timestamps during
+                    # its own copy; `events` is never mutated.
                     self._store_new_transfers(events, ts=ts)
                 else:
                     recs = events[ok].copy()
                     recs["timestamp"] = ts[ok]
                     self._store_new_transfers(recs)
             self.commit_timestamp = int(ts[ok][-1])
-        return _codes_to_results(code)
+        return _codes_to_results(codes)
 
     def _device_batch(self, events, ts, dr_slots, cr_slots, host_code):
         """Pack events into the kernel's SoA form, padded to a power-of-two
@@ -1089,31 +1102,13 @@ class StateMachine:
             codes = host_kernel.validate(
                 events, ts, dr_slots, cr_slots, self.acc_ledger, host_code
             )
-        ok = codes == 0
         pend = (events["flags"].astype(np.uint32) & np.uint32(TransferFlags.PENDING)) != 0
-        with tracer.span("sm.ct.post"):
-            overflow = host_kernel.post(
-                self._host_bal,
-                dr_slots, cr_slots,
-                events["amount_lo"].astype(np.uint64), events["amount_hi"].astype(np.uint64),
-                ok & pend, ok & ~pend,
-            )
-        if overflow:
-            self.stats["bail_batches"] += 1
-            return self._create_transfers_serial(events, timestamp)
-        self.stats["fast_batches"] += 1
-        if np.any(ok):
-            with tracer.span("sm.ct.store"):
-                if ok.all():
-                    # Zero-copy: the log's append stamps timestamps during
-                    # its own copy; `events` is never mutated.
-                    self._store_new_transfers(events, ts=ts)
-                else:
-                    recs = events[ok].copy()
-                    recs["timestamp"] = ts[ok]
-                    self._store_new_transfers(recs)
-            self.commit_timestamp = int(ts[ok][-1])
-        return _codes_to_results(codes)
+        return self._commit_fast_numpy(
+            events, ts, codes, dr_slots, cr_slots,
+            events["amount_lo"].astype(np.uint64),
+            events["amount_hi"].astype(np.uint64),
+            pend, timestamp,
+        )
 
     # ------------------------------------------------------------------
     # serial (exact) path — runs the oracle over lazily-prefetched state

@@ -282,21 +282,18 @@ class Cluster:
             return 0
         import io
 
-        # Excluded sections: client_replies embed the RESPONDING replica's
-        # id in their sealed headers (the reference's client_replies zone
-        # is also per-replica), and the grid-LAYOUT sections (block
-        # addresses in log_blocks/log_tail, manifests, free set) are
-        # per-replica once any replica state-synced — install() rebuilds
-        # its LSM one-shot, producing different block placement for
-        # identical logical content. Everything content-level — balances,
-        # account columns, posted, history, timestamps, and the replicated
-        # client TABLE rows (replica-independent) — must be byte-identical.
-        skip = {
-            "client_replies",
-            "log_blocks", "log_tail", "ti_manifest", "ai_manifest",
-            "ti_fences", "ti_fence_counts", "ai_fences", "ai_fence_counts",
-            "free_set",
-        }
+        # The ONLY excluded section: client_replies embed the RESPONDING
+        # replica's id in their sealed headers (the reference's
+        # client_replies zone is also per-replica). Everything else —
+        # including every grid-layout section (log blocks, manifests,
+        # fences, block checksums, free set) — must be byte-identical:
+        # grid allocation is deterministic by construction (sequential
+        # acquire cursor + per-op beat pacing), and a state-synced replica
+        # ADOPTS the server's layout block-for-block (block-level sync
+        # writes fetched blocks at identical indices). The reference's
+        # storage_checker.zig compares checkpointed bytes unconditionally;
+        # so do we.
+        skip = {"client_replies"}
         sections = {}
         for i in at_top:
             # Grid-resident checkpoints: the blob is read back from the

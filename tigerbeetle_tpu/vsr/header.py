@@ -48,6 +48,11 @@ class Command:
     EVICTION = 18
     REQUEST_SYNC_CHECKPOINT = 19
     SYNC_CHECKPOINT = 20
+    # Block-level state sync (reference request_blocks/block,
+    # replica.zig:2289,2413): fetch exactly the grid blocks a checkpoint
+    # references that the local grid is missing.
+    REQUEST_BLOCKS = 21
+    BLOCK = 22
     NAMES = {}
 
 

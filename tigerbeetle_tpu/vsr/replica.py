@@ -174,6 +174,9 @@ class Replica:
         # (checkpoint_op, blob, checksum) cache.
         self._sync: Optional[dict] = None
         self._sync_serve_cache: Optional[tuple] = None
+        # Block-level sync progress: {missing: {index: cks}, requested,
+        # peer, last_tick, stalls, fetched}; commits are gated while set.
+        self._block_sync: Optional[dict] = None
 
         # Injected time + cluster clock (reference clock.zig via ping/pong
         # offset samples; DeterministicTime keeps simulations reproducible).
@@ -259,6 +262,7 @@ class Replica:
         self.commit_max = max(st.commit_max, st.op_checkpoint)
         self.checksum_floor = st.op_checkpoint
 
+        resume_block_sync: Optional[Dict[int, int]] = None
         if st.op_checkpoint > 0:
             # Load the checkpoint trailer the superblock references — by
             # construction EXACTLY the durable checkpoint's state (a newer
@@ -268,30 +272,45 @@ class Replica:
             assert st.trailer_block != NO_TRAILER, (
                 "superblock references a checkpoint but carries no trailer"
             )
-            self._load_snapshot(self._trailer_read(st.trailer_block))
+            blob = self._trailer_read(st.trailer_block)
+            if st.sync_pending:
+                # Crashed mid block-sync: the trailer's RAM state is valid
+                # but referenced content blocks may still be missing —
+                # resume fetching before any execution (the Bloom rebuild
+                # waits too: it scans log blocks).
+                tracer.count("mark.state_sync_install")
+                snapshot.install(self, blob, rebuild_bloom=False)
+                resume_block_sync = snapshot.block_checksums(blob)
+            else:
+                self._load_snapshot(blob)
+            # The encoded free set covers content blocks only; the
+            # trailer's own (per-replica) blocks are re-marked from the
+            # superblock reference.
+            self._mark_trailer_allocated()
 
         self.journal.recover(self.cluster)
         self.journal.flush_dirty()
         self.op = max(self.journal.highest_op(), st.op_checkpoint)
 
-        # Re-execute contiguous committed prepares beyond the checkpoint.
-        replay_to = min(self.commit_max, self.op)
-        for op in range(st.op_checkpoint + 1, replay_to + 1):
-            msg = self.journal.read_prepare(op)
-            if msg is None:
-                break
-            self._execute(msg, replay=True)
-            self.commit_min = op
-        if self.replica_count == 1:
-            # Single replica: every durable prepare is committable.
-            for op in range(self.commit_min + 1, self.op + 1):
+        if resume_block_sync is None:
+            # Re-execute contiguous committed prepares beyond the checkpoint.
+            replay_to = min(self.commit_max, self.op)
+            for op in range(st.op_checkpoint + 1, replay_to + 1):
                 msg = self.journal.read_prepare(op)
                 if msg is None:
-                    self.op = op - 1  # torn tail — truncate
                     break
                 self._execute(msg, replay=True)
                 self.commit_min = op
-            self.commit_max = max(self.commit_max, self.commit_min)
+            if self.replica_count == 1:
+                # Single replica: every durable prepare is committable.
+                for op in range(self.commit_min + 1, self.op + 1):
+                    msg = self.journal.read_prepare(op)
+                    if msg is None:
+                        self.op = op - 1  # torn tail — truncate
+                        break
+                    self._execute(msg, replay=True)
+                    self.commit_min = op
+                self.commit_max = max(self.commit_max, self.commit_min)
         if self.replica_count == 1:
             self.status = STATUS_NORMAL
         else:
@@ -301,6 +320,8 @@ class Replica:
             # and serve stale state.
             self.status = STATUS_RECOVERING
             self.recovering_since = self.tick_count
+        if resume_block_sync is not None:
+            self._begin_block_sync(resume_block_sync)
         self.on_event("open", self)
 
     # ------------------------------------------------------------------
@@ -375,6 +396,8 @@ class Replica:
             Command.HEADERS: self.on_headers,
             Command.REQUEST_SYNC_CHECKPOINT: self.on_request_sync_checkpoint,
             Command.SYNC_CHECKPOINT: self.on_sync_checkpoint,
+            Command.REQUEST_BLOCKS: self.on_request_blocks,
+            Command.BLOCK: self.on_block,
             Command.PING: self.on_ping,
             Command.PONG: self.on_pong,
         }.get(cmd)
@@ -807,6 +830,11 @@ class Replica:
 
     def _commit_journal(self, commit_target: int) -> None:
         self.commit_max = max(self.commit_max, commit_target)
+        if self._block_sync is not None:
+            # Mid block-sync the LSM tier is incomplete: executing an op
+            # could read a grid block that has not arrived yet. Commits
+            # resume from _finish_block_sync.
+            return
         while self.commit_min < self.commit_max:
             op = self.commit_min + 1
             msg = self.journal.read_prepare(op) if self._journal_has_target(op) else None
@@ -996,10 +1024,10 @@ class Replica:
             blob = self._trailer_read(st.trailer_block)
         except IOError:
             return None  # local trailer corrupt — cannot serve sync
-        # Local blobs reference OUR grid blocks; peers need the transfers
-        # materialized (grid-block sync is a later round).
-        export = snapshot.to_export(self, blob)
-        self._sync_serve_cache = (st.op_checkpoint, export, hdr.checksum(export))
+        # Block-level sync: the blob itself is O(accounts + tables); the
+        # peer fetches whichever referenced grid blocks it is missing via
+        # REQUEST_BLOCKS (never the whole history).
+        self._sync_serve_cache = (st.op_checkpoint, blob, hdr.checksum(blob))
         return self._sync_serve_cache
 
     def _send_sync_chunk(self, peer: int, index: int) -> None:
@@ -1050,7 +1078,34 @@ class Replica:
 
     def _sync_tick(self) -> None:
         """Resume a stalled chunked sync (lost or corrupt chunks are simply
-        never delivered — Message.verify drops them — so re-request)."""
+        never delivered — Message.verify drops them — so re-request), and
+        a stalled block sync (lost BLOCKs re-requested; repeated stalls
+        escalate to a fresh trailer request — the serving side may have
+        checkpointed past the content we are fetching)."""
+        bs = self._block_sync
+        if bs is not None and self.tick_count - bs["last_tick"] >= 2 * REPAIR_TIMEOUT:
+            bs["last_tick"] = self.tick_count
+            bs["stalls"] = bs.get("stalls", 0) + 1
+            if self.replica_count > 1:
+                # Rotate the serving peer (it may be down or lagging).
+                nxt = (bs.get("peer", self.replica) + 1) % self.replica_count
+                bs["peer"] = nxt if nxt != self.replica else (
+                    (nxt + 1) % self.replica_count
+                )
+            if bs["stalls"] % 4 == 0 and self.replica_count > 1:
+                # Content may be gone on the peers (blocks reused by newer
+                # checkpoints): restart sync at whatever checkpoint the
+                # cluster now serves. sync_pending stays set until SOME
+                # sync completes.
+                peer = (self.replica + bs["stalls"] // 4) % self.replica_count
+                if peer != self.replica:
+                    rq = hdr.make(
+                        Command.REQUEST_PREPARE, self.cluster,
+                        view=self.view, op=self.commit_min + 1,
+                        replica=self.replica,
+                    )
+                    self.bus.send_to_replica(peer, Message(rq).seal())
+            self._request_missing_blocks(retry=True)
         s = self._sync
         if s is None:
             return
@@ -1099,26 +1154,30 @@ class Replica:
         self._install_sync_checkpoint(sync_op, blob)
 
     def _install_sync_checkpoint(self, sync_op: int, blob: bytes) -> None:
-        """Install a peer's checkpoint, then advance our own durable
-        checkpoint to it and resume WAL repair from there.
+        """Install a peer's checkpoint trailer, persist it as our own
+        durable checkpoint (sync_pending set), then fetch exactly the
+        referenced grid blocks our grid is missing (block-level sync —
+        reference replica.zig:2289,2413, docs/internals/sync.md). Traffic
+        is proportional to the state DELTA: blocks whose local checksum
+        already matches the blob's block_cks list are never transferred.
 
-        Crash-consistency: the install writes ONLY into currently-free grid
-        blocks — blocks referenced by the last durable checkpoint (and by
-        the live state, for rollback) are untouched, so a crash at any
-        point before the new superblock is durable recovers cleanly to the
-        old checkpoint. Stale blocks are reclaimed only after the new
-        checkpoint lands, by rewinding the free set to the freshly encoded
-        local blob.
+        Crash-consistency: before the superblock flip, only currently-free
+        blocks are written (the trailer), so a crash recovers the old
+        checkpoint. After the flip (sync_pending durable), missing-block
+        writes may overwrite stale old-checkpoint blocks — a crash then
+        resumes block sync at open() from the durable trailer.
         """
         # Parse-validate BEFORE any destructive step: a checksum-consistent
         # but structurally malformed blob (corrupt store entry or forged
         # ident) must neither crash the replica loop nor destroy state.
-        if not snapshot.validate_export(blob):
+        if not snapshot.validate(blob):
             return
         from tigerbeetle_tpu.io.grid import FreeSet
 
         grid = self.state_machine.grid
         old_sm, old_clients, old_free = self.state_machine, self.clients, grid.free_set
+        old_trailer = list(self._trailer_blocks)
+        old_block_cks = dict(grid.block_cks)
         install_free = FreeSet(grid.block_count)
         install_free.free = old_free.free.copy()  # staged frees stay allocated
         grid.free_set = install_free
@@ -1128,15 +1187,34 @@ class Replica:
         # The client table is replicated state — it must exactly match the
         # installed checkpoint, so sessions from before the sync are dropped.
         self.clients = {}
+        wanted = snapshot.block_checksums(blob)
         try:
-            self._load_snapshot(blob)
+            tracer.count("mark.state_sync_install")
+            # RAM state + manifests only; the free-set restore inside is
+            # overwritten below (install_free governs until the flip), and
+            # the Bloom rebuild waits for the log blocks to arrive.
+            snapshot.install(self, blob, rebuild_bloom=False)
         except Exception:
-            # Residual failure (e.g. grid transiently full): every block the
-            # old state references is intact — roll back wholesale.
+            # Residual failure: every block the old state references is
+            # intact — roll back wholesale (including the checksum map,
+            # which install() already overlaid with the peer's entries).
             grid.free_set = old_free
+            grid.block_cks = old_block_cks
             grid.drop_cache()
             self.state_machine, self.clients = old_sm, old_clients
+            self._trailer_blocks = old_trailer
             return
+        # install() rewound the free set (in place) to the blob's
+        # references-exact bits; reinstate the INSTALL bits until the
+        # superblock flip — the trailer must not land on blocks the
+        # rollback state (or our previous trailer) still needs. Blocks the
+        # INSTALLED checkpoint references are additionally excluded: block
+        # sync will write the peer's content at exactly those indices, so
+        # the trailer must not occupy them either.
+        install_free.free = old_free.free.copy()
+        install_free._staged = []
+        if wanted:
+            install_free.free[np.array(sorted(wanted), dtype=np.int64)] = False
         self.commit_min = sync_op
         self.checksum_floor = sync_op
         self.op = max(self.op, sync_op)
@@ -1144,25 +1222,149 @@ class Replica:
         st.op_checkpoint = sync_op
         st.commit_min = sync_op
         st.commit_max = max(st.commit_max, sync_op)
-        # Persist OUR OWN local-mode checkpoint of the installed state (the
-        # export blob references no grid blocks and would force a full LSM
-        # rebuild on restart) as a grid trailer, make its blocks durable,
-        # then advance the superblock. _trailer_write allocates from the
-        # install free set, which still holds every pre-sync block
-        # allocated — the rollback state stays intact until the superblock
-        # lands.
         st.trailer_block = self._trailer_write()
+        st.sync_pending = 1
         self.storage.sync()
         self.superblock.checkpoint()
-        # New checkpoint durable: reclaim everything it does not reference
-        # (the old checkpoint's and pre-sync live blocks). The trailer's
-        # encoded free set is references-exact (snapshot.referenced_blocks),
-        # so restoring it drops every stale pre-sync allocation the install
-        # free set was still carrying.
+        # Flip durable: now adopt the references-exact free set (trailer
+        # blocks re-marked — they are excluded from the encoding) and
+        # start fetching the missing content blocks.
         fs = snapshot.free_set_bytes(self._trailer_read(st.trailer_block))
         assert fs is not None
         grid.free_set.restore(fs)
+        self._mark_trailer_allocated()
+        grid.drop_cache()
         self._sync_serve_cache = None
+        self._begin_block_sync(wanted)
+
+    # --- block-level sync (receiver) ------------------------------------
+
+    BLOCKS_PER_REQUEST = 64
+    BLOCK_REQUESTS_IN_FLIGHT = 4
+
+    def _begin_block_sync(self, wanted: Dict[int, int]) -> None:
+        """Verify the local grid against the checkpoint's (index,
+        checksum) list; fetch only mismatches. Commits stay gated until
+        every referenced block is present."""
+        grid = self.state_machine.grid
+        missing = {
+            b: c for b, c in wanted.items() if grid.local_checksum(b) != c
+        }
+        tracer.count("mark.block_sync_begin")
+        self._block_sync = {
+            "missing": missing, "requested": set(),
+            "last_tick": self.tick_count, "fetched": 0,
+        }
+        # Observability (tests + ops): how much of the referenced set the
+        # local grid already held — the delta-proportionality of sync.
+        self.block_sync_stats = {"wanted": len(wanted), "missing": len(missing)}
+        log.info(
+            "replica %d: block sync: %d/%d blocks missing",
+            self.replica, len(missing), len(wanted),
+        )
+        if not missing:
+            self._finish_block_sync()
+            return
+        self._request_missing_blocks()
+
+    def _request_missing_blocks(self, retry: bool = False) -> None:
+        s = self._block_sync
+        if s is None or not s["missing"]:
+            return
+        if retry:
+            # Everything outstanding is presumed lost (or the peer lacked
+            # it): forget the in-flight set so the blocks are re-requested
+            # (from the rotated peer).
+            s["requested"].clear()
+        window = self.BLOCK_REQUESTS_IN_FLIGHT * self.BLOCKS_PER_REQUEST
+        outstanding = len(s["requested"])
+        # Low-water top-up: re-requesting on every BLOCK arrival would send
+        # one single-index request per remaining block; refill in full
+        # batches once half the window has drained.
+        if outstanding > window // 2:
+            return
+        to_request = [
+            b for b in sorted(s["missing"]) if b not in s["requested"]
+        ][: window - outstanding]
+        if not to_request:
+            return
+        s["requested"].update(to_request)
+        peer = s.get("peer")
+        if peer is None or peer == self.replica:
+            peer = (self.replica + 1) % self.replica_count
+            s["peer"] = peer
+        if peer == self.replica:
+            return  # single-replica cluster: nothing to fetch from
+        for i in range(0, len(to_request), self.BLOCKS_PER_REQUEST):
+            chunk = to_request[i : i + self.BLOCKS_PER_REQUEST]
+            body = np.array(chunk, dtype=np.uint32).tobytes()
+            rq = hdr.make(
+                Command.REQUEST_BLOCKS, self.cluster,
+                view=self.view, replica=self.replica,
+            )
+            self.bus.send_to_replica(peer, Message(rq, body).seal())
+
+    def on_request_blocks(self, msg: Message) -> None:
+        """Serve grid blocks by index (reference on_request_blocks,
+        replica.zig:2289). Content identity is the receiver's problem: it
+        verifies each payload against its wanted checksum, so serving a
+        since-reused block is harmless (re-requested elsewhere)."""
+        peer = msg.header["replica"]
+        indices = np.frombuffer(msg.body, dtype=np.uint32)
+        grid = self.state_machine.grid
+        for b in indices[: self.BLOCKS_PER_REQUEST]:
+            try:
+                payload, btype = grid.read_block_typed(int(b))
+            except (IOError, AssertionError):
+                continue  # torn/corrupt/out-of-range: peer re-requests
+            bh = hdr.make(
+                Command.BLOCK, self.cluster,
+                view=self.view, replica=self.replica,
+                op=int(b), request=btype,
+            )
+            self.bus.send_to_replica(peer, Message(bh, payload).seal())
+
+    def on_block(self, msg: Message) -> None:
+        s = self._block_sync
+        if s is None:
+            return
+        h = msg.header
+        index = h["op"]
+        want = s["missing"].get(index)
+        if want is None:
+            return
+        if hdr.checksum(msg.body) != want:
+            # Stale content (the peer reused the block since the trailer we
+            # installed): drop; the stall path re-requests and eventually
+            # restarts sync at a newer checkpoint.
+            s["requested"].discard(index)
+            return
+        self.state_machine.grid.write_block_at(index, msg.body, h["request"])
+        del s["missing"][index]
+        s["requested"].discard(index)
+        s["fetched"] += 1
+        s["last_tick"] = self.tick_count
+        if s["missing"]:
+            self._request_missing_blocks()
+        else:
+            self._finish_block_sync()
+
+    def _finish_block_sync(self) -> None:
+        """Every referenced block present: make them durable, clear the
+        sync_pending flag, rebuild RAM-only derived state, resume."""
+        fetched = self._block_sync["fetched"] if self._block_sync else 0
+        self._block_sync = None
+        self.storage.sync()
+        st = self.superblock.state
+        if st.sync_pending:
+            st.sync_pending = 0
+            self.superblock.checkpoint()
+        snapshot.rebuild_transfer_bloom(self.state_machine)
+        tracer.count("mark.block_sync_done")
+        log.info(
+            "replica %d: block sync complete (%d blocks fetched)",
+            self.replica, fetched,
+        )
         self.on_event("sync", self)
         self._commit_journal(self.commit_max)
 
@@ -1698,20 +1900,15 @@ class Replica:
         # Stage-release the previous trailer (reclaimed post-durability).
         for b in self._trailer_blocks:
             grid.release(b)
-        reserved: List[int] = []
-        blob = b""
-        for _ in range(8):
-            blob = snapshot.encode(self, trailer_blocks=reserved)
-            need = -(-len(blob) // payload_max) + 1  # chunks + index block
-            assert need - 1 <= fences_max, "checkpoint trailer exceeds one index block"
-            if need == len(reserved):
-                break
-            while len(reserved) < need:
-                reserved.append(grid.free_set.acquire())
-            while len(reserved) > need:
-                grid.free_set.release(reserved.pop())
-        else:
-            raise RuntimeError("checkpoint trailer reservation did not converge")
+        # Trailer blocks come from the TOP of the grid (acquire_high) and
+        # are excluded from the encoded free set: per-replica trailer
+        # placement history must never perturb the deterministic content
+        # layout the storage checker byte-compares. The blob is therefore
+        # independent of the reservation — one encode suffices.
+        blob = snapshot.encode(self)
+        need = -(-len(blob) // payload_max) + 1  # chunks + index block
+        assert need - 1 <= fences_max, "checkpoint trailer exceeds one index block"
+        reserved = [grid.free_set.acquire_high() for _ in range(need)]
         index_block, chunks = reserved[0], reserved[1:]
         for i, b in enumerate(chunks):
             grid.write_block_at(
@@ -1731,6 +1928,11 @@ class Replica:
         )
         self._trailer_blocks = reserved
         return index_block
+
+    def _mark_trailer_allocated(self) -> None:
+        grid = self.state_machine.grid
+        for b in self._trailer_blocks:
+            grid.free_set.free[b] = False
 
     def _trailer_read(self, index_block: int) -> bytes:
         """Read the checkpoint blob back from its trailer blocks; also

@@ -52,6 +52,13 @@ CLIENT_ENTRY_DTYPE = np.dtype(
     ]
 )
 
+# (index, payload checksum) of every content block the checkpoint
+# references — the identity list block-level state sync verifies against
+# (reference: block references carry checksums; grid_blocks_missing.zig).
+BLOCK_CKS_DTYPE = np.dtype(
+    [("block", "<u4"), ("_pad", "<u4"), ("cks_lo", "<u8"), ("cks_hi", "<u8")]
+)
+
 
 def _split(v: int) -> Tuple[int, int]:
     return v & U64_MAX, v >> 64
@@ -98,15 +105,15 @@ def history_from_array(arr: np.ndarray) -> List:
     return out
 
 
-def referenced_blocks(sm, tree_fences, extra=()) -> np.ndarray:
-    """Every grid block the checkpoint references: object-log blocks, each
-    LSM table's index block + data blocks (from `tree_fences`, the fence
-    arrays encode() already computed per tree), plus `extra` (the
-    checkpoint trailer's own reserved blocks). The encoded free set is
-    derived from THIS — references-exact by construction, so it is
-    byte-deterministic across replicas and immune to allocation-history
-    skew (e.g. a synced replica whose live bitset still carries pre-sync
-    allocations)."""
+def referenced_blocks(sm, tree_fences) -> np.ndarray:
+    """Every CONTENT grid block the checkpoint references: object-log
+    blocks, each LSM table's index block + data blocks (from
+    `tree_fences`, the fence arrays encode() already computed per tree).
+    The encoded free set is derived from THIS — references-exact by
+    construction, so it is byte-deterministic across replicas regardless
+    of allocation history. The checkpoint trailer's own blocks are
+    deliberately EXCLUDED (their placement is per-replica); restore paths
+    re-mark them allocated from the superblock's trailer reference."""
     free = np.ones(sm.grid.block_count, dtype=bool)
     blocks = list(sm.transfer_log.blocks)
     for tree, fences in zip((sm.transfer_index, sm.account_rows), tree_fences):
@@ -114,25 +121,23 @@ def referenced_blocks(sm, tree_fences, extra=()) -> np.ndarray:
             for t in level:
                 blocks.append(t.index_block)
         blocks.extend(fences["block"].tolist())
-    blocks.extend(extra)
     if blocks:
         free[np.array(blocks, dtype=np.int64)] = False
     return free
 
 
-def encode(replica, mode: str = "local", trailer_blocks=()) -> bytes:
-    """Serialize the replica's replicated state at its current commit point.
-
-    mode="local": the checkpoint blob for THIS replica's own recovery —
-    transfers stay in the grid; the blob carries only the LSM manifests,
-    the log's block list + tail, and the EWAH free set (small, O(tables)).
-    `trailer_blocks` are the grid blocks reserved for the checkpoint
-    trailer itself — accounted allocated in the encoded free set.
-    mode="export": a self-contained blob for state sync to a peer whose
-    grid differs — transfers are materialized in full (grid-block sync is
-    a later round; reference request_blocks/on_block, replica.zig:2289).
+def encode(replica) -> bytes:
+    """Serialize the replica's replicated state at its current commit
+    point. Transfers stay in the grid; the blob carries the account
+    columns + balances, LSM manifests + fences, the log's block list +
+    tail, the referenced-block checksum list, and the EWAH free set —
+    O(accounts + tables), never O(history). The SAME blob serves local
+    recovery and state sync: a peer installs the RAM state and fetches
+    whichever referenced blocks its own grid is missing (block-level
+    sync, reference replica.zig:2289,2413). Every section is
+    byte-deterministic across replicas (the storage checker compares all
+    of them except per-replica client reply seals).
     """
-    assert mode in ("local", "export")
     sm = replica.state_machine
     count = sm.account_count
     dp, dpo, cp, cpo = sm._read_balances(np.arange(count, dtype=np.int64))
@@ -167,92 +172,98 @@ def encode(replica, mode: str = "local", trailer_blocks=()) -> bytes:
         client_table=client_rows,
         client_replies=np.frombuffer(b"".join(reply_blobs), dtype=np.uint8),
     )
-    if mode == "export":
-        sections["transfers"] = sm.transfer_log.export_all()
-    else:
-        log_blocks, log_tail = sm.transfer_log.checkpoint()
-        sections["ti_manifest"] = sm.transfer_index.checkpoint()
-        sections["ai_manifest"] = sm.account_rows.checkpoint()
-        ti_fences, ti_counts = sm.transfer_index.checkpoint_fences()
-        ai_fences, ai_counts = sm.account_rows.checkpoint_fences()
-        sections["ti_fences"], sections["ti_fence_counts"] = ti_fences, ti_counts
-        sections["ai_fences"], sections["ai_fence_counts"] = ai_fences, ai_counts
-        sections["log_blocks"] = log_blocks
-        sections["log_tail"] = log_tail
-        from tigerbeetle_tpu.io import ewah
+    log_blocks, log_tail = sm.transfer_log.checkpoint()
+    sections["ti_manifest"] = sm.transfer_index.checkpoint()
+    sections["ai_manifest"] = sm.account_rows.checkpoint()
+    ti_fences, ti_counts = sm.transfer_index.checkpoint_fences()
+    ai_fences, ai_counts = sm.account_rows.checkpoint_fences()
+    sections["ti_fences"], sections["ti_fence_counts"] = ti_fences, ti_counts
+    sections["ai_fences"], sections["ai_fence_counts"] = ai_fences, ai_counts
+    sections["log_blocks"] = log_blocks
+    sections["log_tail"] = log_tail
+    # Identity of every referenced content block, for block-level sync.
+    ref = (
+        [int(b) for b in log_blocks]
+        + [
+            t.index_block
+            for tree in (sm.transfer_index, sm.account_rows)
+            for level in tree.levels
+            for t in level
+        ]
+        + ti_fences["block"].tolist()
+        + ai_fences["block"].tolist()
+    )
+    cks_rows = np.zeros(len(ref), dtype=BLOCK_CKS_DTYPE)
+    for i, b in enumerate(ref):
+        c = sm.grid.block_cks.get(b)
+        if c is None:
+            # Not in the RAM map (block restored before checksum tracking
+            # or map evicted): read it back from the grid once.
+            c = sm.grid.local_checksum(b)
+            assert c is not None, f"referenced block {b} unreadable at checkpoint"
+            sm.grid.block_cks[b] = c
+        cks_rows[i]["block"] = b
+        cks_rows[i]["cks_lo"] = c & U64_MAX
+        cks_rows[i]["cks_hi"] = c >> 64
+    sections["block_cks"] = cks_rows
+    from tigerbeetle_tpu.io import ewah
 
-        sections["free_set"] = np.frombuffer(
-            ewah.encode(ewah.bitset_to_words(
-                referenced_blocks(sm, (ti_fences, ai_fences), extra=trailer_blocks)
-            )),
-            dtype=np.uint8,
-        )
+    sections["free_set"] = np.frombuffer(
+        ewah.encode(ewah.bitset_to_words(
+            referenced_blocks(sm, (ti_fences, ai_fences))
+        )),
+        dtype=np.uint8,
+    )
 
     buf = _io.BytesIO()
     np.savez(buf, **sections)
     return buf.getvalue()
 
 
-def to_export(replica, local_blob: bytes) -> bytes:
-    """Serve side of state sync: turn a local checkpoint blob into a
-    self-contained export blob by materializing the transfer log the local
-    manifest references (the serving replica's own grid blocks — immutable
-    until the next checkpoint commits, by the staged-release discipline)."""
-    z = np.load(_io.BytesIO(local_blob), allow_pickle=False)
-    if "transfers" in z:
-        return local_blob  # already export-shaped
-    from tigerbeetle_tpu import types
-    from tigerbeetle_tpu.lsm.log import DurableLog
-
-    log = DurableLog(replica.state_machine.grid, types.TRANSFER_DTYPE)
-    log.restore(z["log_blocks"], z["log_tail"])
-    skip = {
-        "ti_manifest", "ai_manifest", "ti_fences", "ti_fence_counts",
-        "ai_fences", "ai_fence_counts", "log_blocks", "log_tail", "free_set",
+def block_checksums(blob: bytes) -> dict:
+    """{block index: payload checksum} for every content block the blob
+    references (the receiver side of block-level sync verifies its local
+    grid against this and fetches only mismatches)."""
+    z = np.load(_io.BytesIO(blob), allow_pickle=False)
+    rows = z["block_cks"]
+    return {
+        int(r["block"]): int(r["cks_lo"]) | (int(r["cks_hi"]) << 64)
+        for r in rows
     }
-    sections = {k: z[k] for k in z.files if k not in skip}
-    sections["transfers"] = log.export_all()
-    buf = _io.BytesIO()
-    np.savez(buf, **sections)
-    return buf.getvalue()
 
 
-_EXPORT_REQUIRED = (
+_LOCAL_REQUIRED = (
     "account_count", "acc_key_hi", "acc_key_lo",
     "acc_ud128_lo", "acc_ud128_hi", "acc_ud64", "acc_ud32",
     "acc_ledger", "acc_code", "acc_flags", "acc_ts",
     "bal_dp", "bal_dpo", "bal_cp", "bal_cpo",
-    "transfers", "posted_keys", "posted_vals",
+    "posted_keys", "posted_vals",
     "history", "prepare_timestamp", "commit_timestamp", "client_table",
     "client_replies",
+    "ti_manifest", "ai_manifest", "ti_fences", "ti_fence_counts",
+    "ai_fences", "ai_fence_counts", "log_blocks", "log_tail",
+    "block_cks", "free_set",
 )
 
 
-def validate_export(blob: bytes) -> bool:
-    """Parse-check an export blob BEFORE destructive install: np.load with
-    pickle disabled, every section install() reads present, and shapes
+def validate(blob: bytes) -> bool:
+    """Parse-check a checkpoint blob BEFORE destructive install: np.load
+    with pickle disabled, every section install() reads present, shapes
     coherent. Defense in depth — install() is additionally wrapped in a
     rollback — but a blob passing here should not make install() raise."""
-    from tigerbeetle_tpu import types
-
     try:
         z = np.load(_io.BytesIO(blob), allow_pickle=False)
-        for k in _EXPORT_REQUIRED:
+        for k in _LOCAL_REQUIRED:
             _ = z[k]
         count = int(z["account_count"])
         if count < 0:
             return False
-        for k in _EXPORT_REQUIRED[1:11]:
+        for k in _LOCAL_REQUIRED[1:11]:
             if z[k].shape != (count,):
                 return False
         for k in ("bal_dp", "bal_dpo", "bal_cp", "bal_cpo"):
             if z[k].shape != (count, 4):
                 return False
-        t = z["transfers"]
-        if t.dtype != types.TRANSFER_DTYPE and (
-            t.dtype.itemsize != types.TRANSFER_DTYPE.itemsize or t.ndim != 1
-        ):
-            return False
         if z["posted_keys"].shape != z["posted_vals"].shape:
             return False
         if z["history"].dtype != HISTORY_DTYPE:
@@ -261,14 +272,19 @@ def validate_export(blob: bytes) -> bool:
             return False
         if int(z["client_table"]["reply_len"].sum()) != len(z["client_replies"]):
             return False
+        if z["block_cks"].dtype != BLOCK_CKS_DTYPE:
+            return False
+        if int(z["ti_fence_counts"].sum()) != len(z["ti_fences"]):
+            return False
+        if int(z["ai_fence_counts"].sum()) != len(z["ai_fences"]):
+            return False
         return True
     except Exception:
         return False
 
 
 def free_set_bytes(blob: bytes) -> bytes | None:
-    """The EWAH free-set section of a local checkpoint blob (None for
-    export-shaped blobs)."""
+    """The EWAH free-set section of a checkpoint blob."""
     try:
         z = np.load(_io.BytesIO(blob), allow_pickle=False)
         if "free_set" not in z:
@@ -278,13 +294,24 @@ def free_set_bytes(blob: bytes) -> bytes | None:
         return None
 
 
-def install(replica, blob: bytes) -> None:
+def rebuild_transfer_bloom(sm) -> None:
+    """Rebuild the transfer-id Bloom pre-filter (RAM-only; no false
+    negatives allowed: every stored id must be re-added) by scanning the
+    restored object log. Requires every log block to be present."""
+    for _base, recs in sm.transfer_log.scan_range(0, sm.transfer_log.count):
+        sm.transfer_seen.add(recs["id_lo"], recs["id_hi"])
+
+
+def install(replica, blob: bytes, rebuild_bloom: bool = True) -> None:
     """Install a snapshot into a freshly reset replica state machine.
 
     Strictly ``allow_pickle=False``: a malformed blob raises (the caller
     treats that as a failed sync / corrupt checkpoint), it never executes.
+
+    rebuild_bloom=False defers the transfer-id Bloom rebuild (it scans the
+    object log's grid blocks, which a block-level sync receiver does not
+    hold yet) — the caller runs rebuild_bloom() once the blocks arrive.
     """
-    from tigerbeetle_tpu import types
     from tigerbeetle_tpu.lsm.store import pack_keys
     from tigerbeetle_tpu.vsr.header import Message
     from tigerbeetle_tpu.vsr.replica import ClientSession
@@ -312,28 +339,18 @@ def install(replica, blob: bytes) -> None:
         np.arange(count, dtype=np.int32),
         z["bal_dp"], z["bal_dpo"], z["bal_cp"], z["bal_cpo"],
     )
-    if "transfers" in z:
-        # Export blob (state sync): rebuild the LSM tier in our own grid.
-        transfers = z["transfers"]
-        if len(transfers):
-            if transfers.dtype != types.TRANSFER_DTYPE:
-                transfers = transfers.view(types.TRANSFER_DTYPE)
-            sm._store_new_transfers(transfers)
-    else:
-        # Local checkpoint blob: state lives in our grid — rewind the free
-        # set to the checkpoint and re-attach manifests / log blocks.
-        sm.grid.free_set.restore(z["free_set"].tobytes())
-        sm.grid.drop_cache()
-        sm.transfer_index.restore(z["ti_manifest"])
-        sm.transfer_index.attach_fences(z["ti_fences"], z["ti_fence_counts"])
-        sm.account_rows.restore(z["ai_manifest"])
-        sm.account_rows.attach_fences(z["ai_fences"], z["ai_fence_counts"])
-        sm.transfer_log.restore(z["log_blocks"], z["log_tail"])
-        # Rebuild the transfer-id Bloom pre-filter (RAM-only, no false
-        # negatives allowed: every stored id must be re-added) by scanning
-        # the restored object log.
-        for _base, recs in sm.transfer_log.scan_range(0, sm.transfer_log.count):
-            sm.transfer_seen.add(recs["id_lo"], recs["id_hi"])
+    # Checkpoint state lives in the grid — rewind the free set to the
+    # checkpoint and re-attach manifests / fences / log blocks.
+    sm.grid.free_set.restore(z["free_set"].tobytes())
+    sm.grid.drop_cache()
+    sm.grid.block_cks.update(block_checksums(blob))
+    sm.transfer_index.restore(z["ti_manifest"])
+    sm.transfer_index.attach_fences(z["ti_fences"], z["ti_fence_counts"])
+    sm.account_rows.restore(z["ai_manifest"])
+    sm.account_rows.attach_fences(z["ai_fences"], z["ai_fence_counts"])
+    sm.transfer_log.restore(z["log_blocks"], z["log_tail"])
+    if rebuild_bloom:
+        rebuild_transfer_bloom(sm)
     sm.posted = {
         int(k): int(v) for k, v in zip(z["posted_keys"], z["posted_vals"])
     }

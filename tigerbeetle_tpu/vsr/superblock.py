@@ -45,7 +45,12 @@ SUPERBLOCK_DTYPE = np.dtype(
         # blocks referenced from the superblock — ONE data file, no side
         # files). NO_TRAILER when op_checkpoint == 0.
         ("trailer_block", "<u4"),
-        ("reserved", "V380"),
+        # Nonzero while block-level state sync is incomplete: the trailer's
+        # RAM state is installed but some referenced grid blocks are still
+        # missing — the replica must finish fetching them before serving
+        # (reference sync.zig SyncStage persistence).
+        ("sync_pending", "<u4"),
+        ("reserved", "V376"),
     ]
 )
 assert SUPERBLOCK_DTYPE.itemsize == 512
@@ -69,6 +74,7 @@ class VSRState:
     commit_timestamp: int = 0
     parent: int = 0
     trailer_block: int = 0xFFFFFFFF  # NO_TRAILER
+    sync_pending: int = 0
     sequence: int = field(default=0)
 
 
@@ -99,6 +105,7 @@ class SuperBlock:
         rec["parent_lo"] = s.parent & ((1 << 64) - 1)
         rec["parent_hi"] = s.parent >> 64
         rec["trailer_block"] = s.trailer_block
+        rec["sync_pending"] = s.sync_pending
         c = checksum(rec.tobytes()[16:])
         rec["checksum_lo"] = c & ((1 << 64) - 1)
         rec["checksum_hi"] = c >> 64
@@ -126,6 +133,7 @@ class SuperBlock:
             commit_timestamp=int(rec["commit_timestamp"]),
             parent=int(rec["parent_lo"]) | (int(rec["parent_hi"]) << 64),
             trailer_block=int(rec["trailer_block"]),
+            sync_pending=int(rec["sync_pending"]),
             sequence=int(rec["sequence"]),
         )
 
