@@ -85,10 +85,10 @@ class TestSnapshotFormat:
         r2 = cl2.replicas[0]
         r2._load_snapshot(blob)
         assert r2._save_snapshot() == blob
-        assert r2.state_machine.posted == r0.state_machine.posted
-        assert len(r2.state_machine.history) == len(r0.state_machine.history)
-        h0, h2 = r0.state_machine.history[0], r2.state_machine.history[0]
-        assert h0 == h2
+        # Posted + history grooves restored: byte-equal blobs imply equal
+        # manifests; counts confirm the restore attached real state.
+        assert r2.state_machine.posted.count == r0.state_machine.posted.count > 0
+        assert r2.state_machine.history.count == r0.state_machine.history.count > 0
         out = r2.state_machine.lookup_accounts(
             np.array([1], dtype=np.uint64), np.array([0], dtype=np.uint64)
         )

@@ -74,6 +74,10 @@ class Config:
     # tier; files are sparse so production reserves address space cheaply.
     lsm_block_size: int = 1 << 18  # 256 KiB
     grid_block_count: int = 1 << 15  # × 256 KiB = 8 GiB
+    # Grid block LRU cache (reference cache_grid flag, 1 GiB default):
+    # point lookups over a compacted store are RAM-resident when the hot
+    # set fits here.
+    grid_cache_blocks: int = 1 << 12  # × 256 KiB = 1 GiB
     # Transfer-id / account-index memtable rows before a level-0 flush.
     index_memtable_rows: int = 1 << 17
 
@@ -85,6 +89,7 @@ DEVELOPMENT = Config(
     transfers_max=1 << 20,
     lsm_block_size=1 << 16,
     grid_block_count=1 << 13,  # 512 MiB
+    grid_cache_blocks=1 << 11,  # 128 MiB
     index_memtable_rows=1 << 14,
 )
 TEST_MIN = Config(
@@ -100,6 +105,7 @@ TEST_MIN = Config(
     message_size_max=HEADER_SIZE + 64 * 128,
     lsm_block_size=1 << 12,  # 4 KiB
     grid_block_count=1 << 12,  # 16 MiB
+    grid_cache_blocks=64,
     index_memtable_rows=512,
 )
 

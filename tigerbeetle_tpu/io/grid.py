@@ -69,9 +69,11 @@ class FreeSet:
         return int(self.free.sum())
 
     def acquire(self) -> int:
+        if self._low >= len(self.free):
+            raise RuntimeError("grid full: no free blocks")
         off = int(np.argmax(self.free[self._low :]))
         ix = self._low + off
-        if ix >= len(self.free) or not self.free[ix]:
+        if not self.free[ix]:
             raise RuntimeError("grid full: no free blocks")
         self.free[ix] = False
         self._low = ix + 1

@@ -140,6 +140,7 @@ class Replica:
             self.grid = Grid(
                 storage, zone.grid_offset, zone.grid_block_count,
                 zone.grid_block_size, defer_releases=True,
+                cache_blocks=config.grid_cache_blocks,
             )
         else:
             self.grid = None
@@ -279,8 +280,11 @@ class Replica:
                 # resume fetching before any execution (the Bloom rebuild
                 # waits too: it scans log blocks).
                 tracer.count("mark.state_sync_install")
-                snapshot.install(self, blob, rebuild_bloom=False)
                 resume_block_sync = snapshot.block_checksums(blob)
+                snapshot.install(
+                    self, blob, rebuild_bloom=False,
+                    block_cks_map=resume_block_sync,
+                )
             else:
                 self._load_snapshot(blob)
             # The encoded free set covers content blocks only; the
@@ -1193,7 +1197,9 @@ class Replica:
             # RAM state + manifests only; the free-set restore inside is
             # overwritten below (install_free governs until the flip), and
             # the Bloom rebuild waits for the log blocks to arrive.
-            snapshot.install(self, blob, rebuild_bloom=False)
+            snapshot.install(
+                self, blob, rebuild_bloom=False, block_cks_map=wanted
+            )
         except Exception:
             # Residual failure: every block the old state references is
             # intact — roll back wholesale (including the checksum map,

@@ -29,19 +29,8 @@ import numpy as np
 U64_MAX = (1 << 64) - 1
 
 # One AccountHistoryGrooveValue row; u128 values as (lo, hi) u64 pairs.
-HISTORY_DTYPE = np.dtype(
-    [("timestamp", "<u8")]
-    + [
-        (f"{side}_{field}_{half}", "<u8")
-        for side in ("dr", "cr")
-        for field in (
-            "account_id",
-            "debits_pending", "debits_posted",
-            "credits_pending", "credits_posted",
-        )
-        for half in ("lo", "hi")
-    ]
-)
+# (The durable history groove stores exactly this layout on disk.)
+from tigerbeetle_tpu.lsm.groove import HISTORY_DTYPE  # noqa: E402
 
 CLIENT_ENTRY_DTYPE = np.dtype(
     [
@@ -105,6 +94,21 @@ def history_from_array(arr: np.ndarray) -> List:
     return out
 
 
+def content_trees(sm):
+    """(prefix, DurableIndex) for every LSM tree the checkpoint persists."""
+    return (
+        ("ti", sm.transfer_index),
+        ("ai", sm.account_rows),
+        ("po", sm.posted.index),
+        ("hi", sm.history.rows),
+    )
+
+
+def content_logs(sm):
+    """(prefix, DurableLog) for every object log the checkpoint persists."""
+    return (("log", sm.transfer_log), ("hlog", sm.history.log))
+
+
 def referenced_blocks(sm, tree_fences) -> np.ndarray:
     """Every CONTENT grid block the checkpoint references: object-log
     blocks, each LSM table's index block + data blocks (from
@@ -115,8 +119,10 @@ def referenced_blocks(sm, tree_fences) -> np.ndarray:
     deliberately EXCLUDED (their placement is per-replica); restore paths
     re-mark them allocated from the superblock's trailer reference."""
     free = np.ones(sm.grid.block_count, dtype=bool)
-    blocks = list(sm.transfer_log.blocks)
-    for tree, fences in zip((sm.transfer_index, sm.account_rows), tree_fences):
+    blocks = []
+    for _name, log in content_logs(sm):
+        blocks.extend(log.blocks)
+    for (_name, tree), fences in zip(content_trees(sm), tree_fences):
         for level in tree.levels:
             for t in level:
                 blocks.append(t.index_block)
@@ -153,7 +159,7 @@ def encode(replica) -> bytes:
         reply_blobs.append(raw)
 
     sections = dict(
-        version=np.uint32(3),
+        version=np.uint32(4),
         account_count=np.int64(count),
         acc_key_hi=sm.acc_key["hi"][:count], acc_key_lo=sm.acc_key["lo"][:count],
         acc_ud128_lo=sm.acc_user_data_128_lo[:count],
@@ -162,37 +168,32 @@ def encode(replica) -> bytes:
         acc_ledger=sm.acc_ledger[:count], acc_code=sm.acc_code[:count],
         acc_flags=sm.acc_flags[:count], acc_ts=sm.acc_timestamp[:count],
         bal_dp=dp, bal_dpo=dpo, bal_cp=cp, bal_cpo=cpo,
-        posted_keys=np.array(sorted(sm.posted.keys()), dtype=np.uint64),
-        posted_vals=np.array(
-            [sm.posted[k] for k in sorted(sm.posted.keys())], dtype=np.uint8
-        ),
-        history=history_to_array(sm.history),
         prepare_timestamp=np.uint64(replica.committed_timestamp_max),
         commit_timestamp=np.uint64(sm.commit_timestamp),
         client_table=client_rows,
         client_replies=np.frombuffer(b"".join(reply_blobs), dtype=np.uint8),
     )
-    log_blocks, log_tail = sm.transfer_log.checkpoint()
-    sections["ti_manifest"] = sm.transfer_index.checkpoint()
-    sections["ai_manifest"] = sm.account_rows.checkpoint()
-    ti_fences, ti_counts = sm.transfer_index.checkpoint_fences()
-    ai_fences, ai_counts = sm.account_rows.checkpoint_fences()
-    sections["ti_fences"], sections["ti_fence_counts"] = ti_fences, ti_counts
-    sections["ai_fences"], sections["ai_fence_counts"] = ai_fences, ai_counts
-    sections["log_blocks"] = log_blocks
-    sections["log_tail"] = log_tail
+    # Posted + history live in durable grooves since round 4: the blob
+    # carries manifests + fences + log block lists — O(tables), no
+    # whole-state re-encode per checkpoint.
+    ref: List[int] = []
+    tree_fences = []
+    for name, log in content_logs(sm):
+        blocks, tail = log.checkpoint()
+        sections[f"{name}_blocks"] = blocks
+        sections[f"{name}_tail"] = tail
+        ref.extend(int(b) for b in blocks)
+    for name, tree in content_trees(sm):
+        sections[f"{name}_manifest"] = tree.checkpoint()
+        fences, counts = tree.checkpoint_fences()
+        sections[f"{name}_fences"] = fences
+        sections[f"{name}_fence_counts"] = counts
+        tree_fences.append(fences)
+        ref.extend(
+            t.index_block for level in tree.levels for t in level
+        )
+        ref.extend(fences["block"].tolist())
     # Identity of every referenced content block, for block-level sync.
-    ref = (
-        [int(b) for b in log_blocks]
-        + [
-            t.index_block
-            for tree in (sm.transfer_index, sm.account_rows)
-            for level in tree.levels
-            for t in level
-        ]
-        + ti_fences["block"].tolist()
-        + ai_fences["block"].tolist()
-    )
     cks_rows = np.zeros(len(ref), dtype=BLOCK_CKS_DTYPE)
     for i, b in enumerate(ref):
         c = sm.grid.block_cks.get(b)
@@ -210,7 +211,7 @@ def encode(replica) -> bytes:
 
     sections["free_set"] = np.frombuffer(
         ewah.encode(ewah.bitset_to_words(
-            referenced_blocks(sm, (ti_fences, ai_fences))
+            referenced_blocks(sm, tree_fences)
         )),
         dtype=np.uint8,
     )
@@ -232,16 +233,19 @@ def block_checksums(blob: bytes) -> dict:
     }
 
 
+_TREE_PREFIXES = ("ti", "ai", "po", "hi")
+_LOG_PREFIXES = ("log", "hlog")
+
 _LOCAL_REQUIRED = (
     "account_count", "acc_key_hi", "acc_key_lo",
     "acc_ud128_lo", "acc_ud128_hi", "acc_ud64", "acc_ud32",
     "acc_ledger", "acc_code", "acc_flags", "acc_ts",
     "bal_dp", "bal_dpo", "bal_cp", "bal_cpo",
-    "posted_keys", "posted_vals",
-    "history", "prepare_timestamp", "commit_timestamp", "client_table",
+    "prepare_timestamp", "commit_timestamp", "client_table",
     "client_replies",
-    "ti_manifest", "ai_manifest", "ti_fences", "ti_fence_counts",
-    "ai_fences", "ai_fence_counts", "log_blocks", "log_tail",
+    *(f"{p}_{s}" for p in _TREE_PREFIXES
+      for s in ("manifest", "fences", "fence_counts")),
+    *(f"{p}_{s}" for p in _LOG_PREFIXES for s in ("blocks", "tail")),
     "block_cks", "free_set",
 )
 
@@ -264,19 +268,16 @@ def validate(blob: bytes) -> bool:
         for k in ("bal_dp", "bal_dpo", "bal_cp", "bal_cpo"):
             if z[k].shape != (count, 4):
                 return False
-        if z["posted_keys"].shape != z["posted_vals"].shape:
-            return False
-        if z["history"].dtype != HISTORY_DTYPE:
-            return False
         if z["client_table"].dtype != CLIENT_ENTRY_DTYPE:
             return False
         if int(z["client_table"]["reply_len"].sum()) != len(z["client_replies"]):
             return False
         if z["block_cks"].dtype != BLOCK_CKS_DTYPE:
             return False
-        if int(z["ti_fence_counts"].sum()) != len(z["ti_fences"]):
-            return False
-        if int(z["ai_fence_counts"].sum()) != len(z["ai_fences"]):
+        for p in _TREE_PREFIXES:
+            if int(z[f"{p}_fence_counts"].sum()) != len(z[f"{p}_fences"]):
+                return False
+        if z["hlog_tail"].dtype != HISTORY_DTYPE:
             return False
         return True
     except Exception:
@@ -302,7 +303,8 @@ def rebuild_transfer_bloom(sm) -> None:
         sm.transfer_seen.add(recs["id_lo"], recs["id_hi"])
 
 
-def install(replica, blob: bytes, rebuild_bloom: bool = True) -> None:
+def install(replica, blob: bytes, rebuild_bloom: bool = True,
+            block_cks_map: dict | None = None) -> None:
     """Install a snapshot into a freshly reset replica state machine.
 
     Strictly ``allow_pickle=False``: a malformed blob raises (the caller
@@ -311,6 +313,8 @@ def install(replica, blob: bytes, rebuild_bloom: bool = True) -> None:
     rebuild_bloom=False defers the transfer-id Bloom rebuild (it scans the
     object log's grid blocks, which a block-level sync receiver does not
     hold yet) — the caller runs rebuild_bloom() once the blocks arrive.
+    block_cks_map: pre-parsed block_checksums(blob), when the caller
+    already computed it (avoids re-parsing the multi-MB blob).
     """
     from tigerbeetle_tpu.lsm.store import pack_keys
     from tigerbeetle_tpu.vsr.header import Message
@@ -340,21 +344,20 @@ def install(replica, blob: bytes, rebuild_bloom: bool = True) -> None:
         z["bal_dp"], z["bal_dpo"], z["bal_cp"], z["bal_cpo"],
     )
     # Checkpoint state lives in the grid — rewind the free set to the
-    # checkpoint and re-attach manifests / fences / log blocks.
+    # checkpoint and re-attach manifests / fences / log blocks (posted +
+    # history grooves included).
     sm.grid.free_set.restore(z["free_set"].tobytes())
     sm.grid.drop_cache()
-    sm.grid.block_cks.update(block_checksums(blob))
-    sm.transfer_index.restore(z["ti_manifest"])
-    sm.transfer_index.attach_fences(z["ti_fences"], z["ti_fence_counts"])
-    sm.account_rows.restore(z["ai_manifest"])
-    sm.account_rows.attach_fences(z["ai_fences"], z["ai_fence_counts"])
-    sm.transfer_log.restore(z["log_blocks"], z["log_tail"])
+    sm.grid.block_cks.update(
+        block_cks_map if block_cks_map is not None else block_checksums(blob)
+    )
+    for name, tree in content_trees(sm):
+        tree.restore(z[f"{name}_manifest"])
+        tree.attach_fences(z[f"{name}_fences"], z[f"{name}_fence_counts"])
+    for name, dlog in content_logs(sm):
+        dlog.restore(z[f"{name}_blocks"], z[f"{name}_tail"])
     if rebuild_bloom:
         rebuild_transfer_bloom(sm)
-    sm.posted = {
-        int(k): int(v) for k, v in zip(z["posted_keys"], z["posted_vals"])
-    }
-    sm.history = history_from_array(z["history"])
     sm.prepare_timestamp = int(z["prepare_timestamp"])
     replica.committed_timestamp_max = int(z["prepare_timestamp"])
     sm.commit_timestamp = int(z["commit_timestamp"])
