@@ -94,6 +94,26 @@ class TestSnapshotFormat:
         )
         assert types.u128_of(out[0], "debits_posted") == 12
 
+        # Groove CONTENT after a same-grid restore (r2's cross-grid blob
+        # cannot read data blocks, but a crash+restart of r0 itself must
+        # reload identical groove content, not just matching manifests).
+        hist_before = r0.state_machine.get_account_history(9)
+        posted_before = r0.state_machine.posted.count
+        assert len(hist_before) > 0
+        cl.storages[0].sync()
+        cl.crash_replica(0)
+        cl.restart_replica(0)
+        r0b = cl.replicas[0]
+        assert r0b.state_machine.get_account_history(9) == hist_before
+        assert r0b.state_machine.posted.count == posted_before
+        # Posted CONTENT: pending id=51 (posted by id=52) must still read
+        # as POSTED, keyed by its original timestamp.
+        from tigerbeetle_tpu.models.oracle import FULFILLMENT_POSTED
+
+        p51 = r0b.state_machine._fetch_transfer(51)
+        assert p51 is not None
+        assert r0b.state_machine.posted.get(p51.timestamp) == FULFILLMENT_POSTED
+
     def test_client_table_replies_roundtrip(self):
         cl = Cluster(replica_count=1)
         c = setup_client(cl)

@@ -110,7 +110,7 @@ def search_run(
     run_hi = run_keys["hi"]
     m = len(queries)
     if n >= (1 << 18) and m > 64:
-        order = np.argsort(queries["lo"], kind="stable")
+        order = sort_lo_major(queries)  # native radix when available
         loc_out = out[order]
         loc_pending = pending[order]
         _search_core(
@@ -157,16 +157,21 @@ class U128Index:
                 self._merge_runs()
 
     def _flush_memtable(self) -> None:
-        keys = np.concatenate([k for k, _ in self._mem])
-        vals = np.concatenate([v for _, v in self._mem])
+        # Newest batch FIRST before the stable sort: equal keys then keep
+        # newest-wins order, matching NativeU128Map's overwrite semantics
+        # (keys are unique by contract, but a silent inversion here would
+        # make any future re-insert return stale values — ADVICE r3).
+        keys = np.concatenate([k for k, _ in reversed(self._mem)])
+        vals = np.concatenate([v for _, v in reversed(self._mem)])
         order = sort_lo_major(keys)
         self._runs.append((keys[order], vals[order]))
         self._mem = []
         self._mem_count = 0
 
     def _merge_runs(self) -> None:
-        keys = np.concatenate([k for k, _ in self._runs])
-        vals = np.concatenate([v for _, v in self._runs])
+        # Same newest-first discipline across runs (later runs are newer).
+        keys = np.concatenate([k for k, _ in reversed(self._runs)])
+        vals = np.concatenate([v for _, v in reversed(self._runs)])
         order = sort_lo_major(keys)
         self._runs = [(keys[order], vals[order])]
 

@@ -29,20 +29,36 @@ class _Conn:
     # a stuck peer must exert backpressure, not grow our heap without
     # bound. Dropping is safe — every VSR message is retried/re-derived.
     SEND_BUFFER_MAX = 8 * (1 << 20)
+    # Small control-plane messages get extra headroom: replies, view
+    # protocol, and commit heartbeats are the RECOVERY path for everything
+    # the bulk budget drops — dropping a client's reply costs a full
+    # request-retry timeout, dropping START_VIEW can stall a view change.
+    CONTROL_BUFFER_MAX = SEND_BUFFER_MAX + (1 << 20)
+    _CONTROL = frozenset((
+        Command.REPLY, Command.EVICTION, Command.COMMIT,
+        Command.START_VIEW_CHANGE, Command.DO_VIEW_CHANGE, Command.START_VIEW,
+        Command.REQUEST_START_VIEW, Command.PREPARE_OK,
+        Command.PING, Command.PONG, Command.PING_CLIENT, Command.PONG_CLIENT,
+    ))
 
     def __init__(self, writer: asyncio.StreamWriter) -> None:
         self.writer = writer
         self.dropped = 0
 
-    def _can_send(self, size: int) -> bool:
+    def _can_send(self, size: int, command: Optional[int] = None) -> bool:
         """Backpressure guard: drop (and count) when the peer's send
-        buffer is full — every VSR message is retried/re-derived."""
+        buffer is full — every VSR message is retried/re-derived.
+        Control-plane commands use the larger budget (see _CONTROL)."""
         if self.writer.is_closing():
             return False
+        limit = (
+            self.CONTROL_BUFFER_MAX
+            if command in self._CONTROL else self.SEND_BUFFER_MAX
+        )
         transport = self.writer.transport
         if (
             transport is not None
-            and transport.get_write_buffer_size() + size > self.SEND_BUFFER_MAX
+            and transport.get_write_buffer_size() + size > limit
         ):
             self.dropped += 1
             if self.dropped == 1 or self.dropped % 1000 == 0:
@@ -60,19 +76,37 @@ class _Conn:
     def send_message(self, msg: Message) -> None:
         """Frame a message without concatenating header+body (a ~1 MiB
         copy per prepare on the old path)."""
-        if self._can_send(HEADER_SIZE + len(msg.body)):
+        if self._can_send(HEADER_SIZE + len(msg.body), msg.header["command"]):
             self.writer.write(msg.header.to_bytes())
             if msg.body:
                 self.writer.write(msg.body)
 
 
+_algo_mismatch_logged = False
+
+
 async def read_message(reader: asyncio.StreamReader) -> Optional[Message]:
+    global _algo_mismatch_logged
     try:
         hraw = await reader.readexactly(HEADER_SIZE)
     except (asyncio.IncompleteReadError, ConnectionError):
         return None
     h = Header.from_bytes(hraw)
     if not h.valid_checksum():
+        # Distinguish a misconfigured cluster from corruption: replicas
+        # formatted/running under a different TIGERBEETLE_TPU_CHECKSUM
+        # would otherwise fail every MAC silently and never form quorum.
+        if not _algo_mismatch_logged and h.checksum_algorithm_mismatch():
+            _algo_mismatch_logged = True
+            from tigerbeetle_tpu.vsr.header import CHECKSUM_ALGORITHM
+
+            log.error(
+                "peer message authenticates under the OTHER checksum "
+                "algorithm (this host: %s): the cluster is split between "
+                "aegis128l and blake2b hosts — set TIGERBEETLE_TPU_CHECKSUM "
+                "identically on every replica. Dropping all such traffic.",
+                CHECKSUM_ALGORITHM,
+            )
         return None
     size = h["size"]
     if size < HEADER_SIZE or size > (1 << 21):

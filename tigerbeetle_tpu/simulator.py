@@ -262,7 +262,13 @@ def main(argv=None) -> int:
             f"{[(s, taxonomy[rc]) for s, rc in failures] if failures else ''}"
             f" marks={marks}"
         )
-        return EXIT_PASS if not failures else max(rc for _, rc in failures)
+        if not failures:
+            return EXIT_PASS
+        # Severity, not numeric max: a crash (3) must never mask a
+        # correctness failure (1) in the exit code.
+        priority = (EXIT_CORRECTNESS, EXIT_LIVENESS, EXIT_CRASH)
+        codes = {rc for _, rc in failures}
+        return next(rc for rc in priority if rc in codes)
     if args.seed is None:
         p.error("seed or --sweep required")
     return run_seed(args.seed, args.requests, verbose=True)

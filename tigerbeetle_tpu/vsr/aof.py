@@ -21,11 +21,14 @@ Entry layout (little-endian):
 
 from __future__ import annotations
 
+import logging
 import os
 import struct
 from typing import Iterator, List, Optional, Tuple
 
 from tigerbeetle_tpu.vsr.header import Message, checksum
+
+log = logging.getLogger("tigerbeetle_tpu.aof")
 
 MAGIC = 0x41EB00F5_0AF0FEED_C0FFEE00_7B5B71E5
 _MAGIC_BYTES = MAGIC.to_bytes(16, "little")
@@ -149,21 +152,41 @@ def merge(paths: List[str]) -> List[Message]:
     a crashed writer logged), the chain-consistent one — whose parent
     checksum matches op-1's — wins."""
     by_op: dict[int, Message] = {}
-    candidates: dict[int, List[Message]] = {}
-    for path in paths:
+    candidates: dict[int, List[Tuple[Message, int]]] = {}
+    for fi, path in enumerate(paths):
         for m, _, _ in iter_entries(path):
             op = m.header["op"]
-            candidates.setdefault(op, []).append(m)
+            candidates.setdefault(op, []).append((m, fi))
     for op in sorted(candidates):
         opts = candidates[op]
         chosen: Optional[Message] = None
         prev = by_op.get(op - 1)
-        for m in opts:
+        for m, _fi in opts:
             if prev is None or m.header["parent"] == prev.header["checksum"]:
                 chosen = m
                 break
         if chosen is None:
-            chosen = opts[0]
+            # Parent chain broken for every candidate — legitimate for
+            # committed prepares re-sealed across views (the seal checksum
+            # differs between original and re-proposed headers). Prefer
+            # the content the MOST REPLICAS recorded (majority of distinct
+            # source files per body checksum — one file re-appending an op
+            # across views must not outvote other replicas), and log the
+            # ambiguity.
+            votes: dict[int, set] = {}
+            for m, fi in opts:
+                votes.setdefault(m.header["checksum_body"], set()).add(fi)
+            best = max(len(v) for v in votes.values())
+            if len(votes) > 1:
+                log.warning(
+                    "aof merge: op %d has %d divergent bodies across files "
+                    "(no parent-chain match); choosing the majority "
+                    "(%d/%d files)", op, len(votes), best, len(paths),
+                )
+            for m, _fi in opts:
+                if len(votes[m.header["checksum_body"]]) == best:
+                    chosen = m
+                    break
         by_op[op] = chosen
     ops = sorted(by_op)
     # Contiguity: stop at the first gap (a gap means no surviving AOF holds

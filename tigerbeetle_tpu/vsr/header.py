@@ -166,6 +166,23 @@ def checksum(data: bytes | memoryview) -> int:
     return _checksum_fn(data)
 
 
+def _alternate_checksum(data: bytes) -> int | None:
+    """The OTHER algorithm's MAC (diagnostic only): lets a replica tell
+    'peer configured with the other checksum algorithm' apart from plain
+    corruption — without it a mixed cluster silently drops every message
+    and never forms quorum (ADVICE r3 medium)."""
+    if CHECKSUM_ALGORITHM == "blake2b":
+        from tigerbeetle_tpu import native
+
+        mac = native.aegis128l_mac()
+        if mac is None:
+            return None
+        return int.from_bytes(mac(bytes(data)), "little")
+    return int.from_bytes(
+        hashlib.blake2b(bytes(data), digest_size=16).digest(), "little"
+    )
+
+
 class Header:
     """Mutable view over one 256-byte header record."""
 
@@ -206,6 +223,13 @@ class Header:
 
     def valid_checksum(self) -> bool:
         return self["checksum"] == checksum(self.rec.tobytes()[CHECKSUM_SIZE:])
+
+    def checksum_algorithm_mismatch(self) -> bool:
+        """True when the header's MAC validates under the algorithm this
+        host is NOT configured with: the peer (or data file) was written
+        under a different TIGERBEETLE_TPU_CHECKSUM setting."""
+        alt = _alternate_checksum(self.rec.tobytes()[CHECKSUM_SIZE:])
+        return alt is not None and self["checksum"] == alt
 
     def valid_checksum_body(self, body: bytes) -> bool:
         if len(body) != self["size"] - HEADER_SIZE:
