@@ -324,3 +324,119 @@ class TestReplicated:
             )
 
         assert run(12) == run(12)
+
+
+class TestStandby:
+    """Standbys + reconfiguration (reference constants.zig:33 standbys;
+    commit_reconfiguration replica.zig:3842): passive replication at the
+    chain tail, promotion into a vacated active slot via a committed
+    RECONFIGURE op, retirement of a raced-restarted old member."""
+
+    def _loaded(self, seed=91):
+        cl = Cluster(replica_count=3, standby_count=1, seed=seed)
+        c = setup_client(cl)
+        do_request(cl, c, Operation.CREATE_ACCOUNTS, account_batch([1, 2]))
+        for i in range(8):
+            do_request(cl, c, Operation.CREATE_TRANSFERS, transfer_batch([
+                dict(id=1 + i, debit_account_id=1, credit_account_id=2,
+                     amount=1, ledger=1, code=1),
+            ]))
+        return cl, c
+
+    def test_standby_replicates_passively(self):
+        cl, c = self._loaded()
+        sb = cl.replicas[3]
+        assert sb.is_standby
+        target = max(r.commit_min for r in cl.replicas[:3])
+        cl.run_until(lambda: cl.replicas[3].commit_min >= target, 40_000)
+        # Passive: the standby never contributed to any quorum.
+        for r in cl.replicas[:3]:
+            if r is not None and r.is_primary:
+                assert all(
+                    3 not in e.ok_from for e in r.pipeline
+                )
+        cl.check_state_convergence()
+
+    def test_standby_promotes_after_crash_and_acks(self):
+        cl, c = self._loaded(seed=92)
+        target = max(r.commit_min for r in cl.replicas[:3])
+        cl.run_until(lambda: cl.replicas[3].commit_min >= target, 40_000)
+        # Crash a backup for good; promote the standby into its slot.
+        victim = next(
+            r.replica for r in cl.replicas[:3] if r is not None and not r.is_primary
+        )
+        cl.crash_replica(victim)
+        cl.reconfigure_promote(3, victim)
+        cl.run_until(
+            lambda: cl.replicas[victim] is not None
+            and cl.replicas[victim].replica == victim
+            and not cl.replicas[victim].is_standby,
+            60_000,
+        )
+        assert cl.replicas[3] is None  # re-homed
+        # The promoted replica is a first-class voter now: crash ANOTHER
+        # active - commits must still flow (quorum 2 of {remaining, promoted}).
+        other = next(
+            r.replica for r in cl.replicas[:3]
+            if r is not None and r.replica != victim and not r.is_primary
+        )
+        cl.crash_replica(other)
+        for i in range(4):
+            do_request(cl, c, Operation.CREATE_TRANSFERS, transfer_batch([
+                dict(id=500 + i, debit_account_id=1, credit_account_id=2,
+                     amount=2, ledger=1, code=1),
+            ]), 60_000)
+        cl.check_state_convergence()
+
+    def test_raced_restart_of_replaced_member_retires(self):
+        cl, c = self._loaded(seed=93)
+        target = max(r.commit_min for r in cl.replicas[:3])
+        cl.run_until(lambda: cl.replicas[3].commit_min >= target, 40_000)
+        victim = next(
+            r.replica for r in cl.replicas[:3] if r is not None and not r.is_primary
+        )
+        cl.storages[victim].sync()
+        cl.crash_replica(victim)
+        old_storage = cl.storages[victim]
+        cl.reconfigure_promote(3, victim)
+        cl.run_until(
+            lambda: cl.replicas[victim] is not None
+            and not cl.replicas[victim].is_standby,
+            60_000,
+        )
+        promoted = cl.replicas[victim]
+        # The old member comes back from its own (pre-crash) data file: it
+        # must catch up, commit the RECONFIGURE, and retire - never
+        # split-braining the slot.
+        from tigerbeetle_tpu.io.storage import MemStorage  # noqa: F401
+        from tigerbeetle_tpu.vsr.replica import Replica
+        from tigerbeetle_tpu.testing.cluster import _ReplicaBus
+
+        zombie = Replica(
+            cluster=cl.cluster_id, replica_index=victim,
+            replica_count=3, standby_count=1,
+            storage=old_storage, zone=cl.zone, config=cl.config,
+            bus=_ReplicaBus(cl.net, 99), sm_backend="numpy",
+        )
+        zombie.open()
+        # Feed it the committed reconfigure op through repair: simulate by
+        # committing via journal messages is involved; directly execute the
+        # committed prepare from the promoted replica's journal instead.
+        reconf_op = None
+        for op in range(1, promoted.commit_min + 1):
+            m = promoted.journal.read_prepare(op)
+            if m is not None and m.header["operation"] == Operation.RECONFIGURE:
+                reconf_op = op
+                break
+        assert reconf_op is not None
+        for op in range(zombie.commit_min + 1, reconf_op + 1):
+            m = promoted.journal.read_prepare(op)
+            assert m is not None
+            zombie.journal.write_prepare(m)
+            zombie._execute(m, replay=True)
+            zombie.commit_min = op
+        assert zombie.retired
+        # And the promoted replica re-executing its own promotion op on
+        # replay must NOT retire (promoted_at_op guard).
+        assert promoted.superblock.state.promoted_at_op == reconf_op
+        assert not promoted.retired

@@ -47,6 +47,18 @@ def cmd_format(args) -> int:
 
 
 def cmd_start(args) -> int:
+    import logging
+    import os as _os
+
+    # Operational logging (scoped loggers are silent by default):
+    # TIGERBEETLE_TPU_LOG=info|debug|warning enables stderr logging.
+    level = _os.environ.get("TIGERBEETLE_TPU_LOG")
+    if level:
+        logging.basicConfig(
+            level=getattr(logging, level.upper(), logging.INFO),
+            stream=sys.stderr,
+            format="%(asctime)s %(name)s %(levelname)s %(message)s",
+        )
     from tigerbeetle_tpu.constants import config_by_name
     from tigerbeetle_tpu.io.storage import FileStorage, Zone
     from tigerbeetle_tpu.net.bus import ReplicaServer
@@ -67,10 +79,15 @@ def cmd_start(args) -> int:
         from tigerbeetle_tpu.vsr.aof import AOF
 
         aof = AOF(args.path + ".aof")
+    # Standbys (reference standbys, constants.zig:33): addresses beyond
+    # --active-count are passive replicas at the chain tail.
+    active = args.active_count if args.active_count else len(addresses)
+    assert 1 <= active <= len(addresses)
     replica = Replica(
         cluster=args.cluster,
         replica_index=args.replica,
-        replica_count=len(addresses),
+        replica_count=active,
+        standby_count=len(addresses) - active,
         storage=storage,
         zone=zone,
         config=config,
@@ -404,6 +421,8 @@ def main(argv=None) -> int:
     s.add_argument("--cluster", type=int, default=0)
     s.add_argument("--config", default="production")
     s.add_argument("--backend", default="jax", choices=["jax", "numpy"])
+    s.add_argument("--active-count", type=int, default=0,
+                   help="active replicas; addresses beyond this are standbys")
     s.add_argument("--aof", action="store_true",
                    help="append committed prepares to <path>.aof")
     s.set_defaults(fn=cmd_start)

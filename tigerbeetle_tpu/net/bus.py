@@ -131,6 +131,10 @@ class ReplicaServer:
     def __init__(self, replica, addresses: List[Tuple[str, int]]) -> None:
         self.replica = replica
         self.addresses = addresses
+        # Boot index: which address we LISTEN on (static). Protocol
+        # identity is read from the replica dynamically — a promoted
+        # standby keeps its listener but speaks (and self-routes) as its
+        # new active index.
         self.me = replica.replica
         self.peer_conns: Dict[int, _Conn] = {}
         self.client_conns: Dict[int, _Conn] = {}
@@ -138,10 +142,14 @@ class ReplicaServer:
         self._stopping = asyncio.Event()
         replica.bus = self  # inject ourselves as the bus
 
+    @property
+    def me_index(self) -> int:
+        return self.replica.replica
+
     # --- bus interface (called from replica logic) ----------------------
 
     def send_to_replica(self, r: int, msg: Message) -> None:
-        if r == self.me:
+        if r == self.me_index:
             self._dispatch(msg.copy())
             return
         conn = self.peer_conns.get(r)
@@ -210,7 +218,7 @@ class ReplicaServer:
             self.peer_conns[r] = _Conn(writer)
             # Identify ourselves so the acceptor can map the connection.
             hello = Message(
-                Header(None, command=Command.PING, replica=self.me,
+                Header(None, command=Command.PING, replica=self.me_index,
                        cluster=self.replica.cluster)
             ).seal()
             writer.write(hello.to_bytes())
@@ -241,7 +249,7 @@ class ReplicaServer:
                 r = self.replica
                 pong = Header(
                     None, command=Command.PONG_CLIENT, cluster=r.cluster,
-                    replica=self.me, view=r.view, client=client_id,
+                    replica=self.me_index, view=r.view, client=client_id,
                 )
                 conn.send(Message(pong).seal().to_bytes())
                 continue  # hello is transport-level, not for the replica
@@ -252,9 +260,20 @@ class ReplicaServer:
                 if peer_replica is None and client_id is None and h["client"] != 0:
                     client_id = h["client"]
                     self.client_conns.setdefault(client_id, conn)
-            elif peer_replica is None and h["replica"] != self.me:
-                peer_replica = h["replica"]
-                self.peer_conns.setdefault(peer_replica, conn)
+            elif h["replica"] != self.me_index:
+                r = h["replica"]
+                if cmd == Command.PING:
+                    # Latest-wins remap on PINGs ONLY: pings always carry
+                    # the SENDER's identity, so a promoted standby's pings
+                    # re-route its index to this connection. Other commands
+                    # may be forwarded (a chain-relayed PREPARE carries the
+                    # PRIMARY's index) and must never hijack the mapping.
+                    if self.peer_conns.get(r) is not conn:
+                        self.peer_conns[r] = conn
+                    peer_replica = r
+                elif peer_replica is None:
+                    peer_replica = r
+                    self.peer_conns.setdefault(r, conn)
             self._dispatch(msg)
         if client_id is not None and self.client_conns.get(client_id) is conn:
             del self.client_conns[client_id]

@@ -39,6 +39,12 @@ class Simulator:
         rng = random.Random(seed)
         self.replica_count = rng.choice([1, 2, 3, 3, 5])
         self.client_count = rng.choice([1, 1, 2])
+        # Standby (reference standbys + reconfiguration): passive replica
+        # at the chain tail; some schedules promote it into a crashed
+        # member's slot mid-run via a committed RECONFIGURE op.
+        self.standby_count = 1 if (
+            self.replica_count >= 3 and rng.random() < 0.35
+        ) else 0
         loss = rng.choice([0.0, 0.01, 0.05])
         self.big_batches = seed % BIG_BATCH_EVERY == BIG_BATCH_EVERY - 1
         config = TEST_MIN
@@ -57,6 +63,7 @@ class Simulator:
             config=config,
             seed=seed,
             loss=loss,
+            standby_count=self.standby_count,
         )
         self.cluster.net.dup = rng.choice([0.0, 0.02])
         self.workload = Workload(
@@ -82,6 +89,19 @@ class Simulator:
                 pt = rng.randint(100, 1500)
                 self.partition_at[pt] = (("replica", a), ("replica", b))
                 self.heal_at.add(pt + rng.randint(300, 1200))
+        # Promotion schedule: crash one active for good; promote the
+        # standby into its slot (instead of a restart).
+        self.promote_at: dict[int, tuple] = {}
+        if self.standby_count and rng.random() < 0.6:
+            t = rng.randint(300, 900)
+            victim = rng.randrange(self.replica_count)
+            self.crash_at[t] = victim
+            self.restart_at = {
+                k: v for k, v in self.restart_at.items() if v != victim
+            }
+            self.promote_at[t + rng.randint(100, 400)] = (
+                self.replica_count, victim
+            )
         self.log = []
 
     def run(self, tick_budget: int = 200_000) -> int:
@@ -89,6 +109,7 @@ class Simulator:
         for c in cl.clients.values():
             c.register()
         down: set[int] = set()
+        self.promote_pending: tuple | None = None
         tick = 0
         last_progress = 0
         last_done = 0
@@ -123,6 +144,24 @@ class Simulator:
             if tick in self.heal_at:
                 cl.net.heal()
                 self.log.append((tick, "heal"))
+            if tick in self.promote_at:
+                s_ix, target = self.promote_at[tick]
+                if target in down and cl.replicas[s_ix] is not None:
+                    self.promote_pending = (s_ix, target)
+                    self.log.append(
+                        (tick, f"promote standby {s_ix} -> slot {target}")
+                    )
+            if self.promote_pending is not None:
+                s_ix, target = self.promote_pending
+                if cl.replicas[target] is not None:
+                    # Promotion landed: the slot is live again (and must
+                    # not be restarted as the old member).
+                    down.discard(target)
+                    self.promote_pending = None
+                elif tick % 200 == 0:
+                    # Re-issue (the op may have raced a view change whose
+                    # primary was the crashed victim).
+                    cl.reconfigure_promote(s_ix, target)
             cl.step()
             self.workload.tick()
             if self.workload.requests_done > last_done:

@@ -181,9 +181,11 @@ class Cluster:
         seed: int = 0,
         loss: float = 0.0,
         sm_backend: str = "numpy",
+        standby_count: int = 0,
     ) -> None:
         self.cluster_id = 0xC1
         self.replica_count = replica_count
+        self.standby_count = standby_count
         self.config = config
         self.net = PacketSimulator(seed, loss_probability=loss)
         self.zone = Zone.for_config(
@@ -191,13 +193,14 @@ class Cluster:
             grid_block_count=config.grid_block_count,
             grid_block_size=config.lsm_block_size,
         )
+        total = replica_count + standby_count
         self.storages = [
             MemStorage(self.zone.total_size, seed=seed * 97 + i)
-            for i in range(replica_count)
+            for i in range(total)
         ]
-        self.replicas: List[Optional[Replica]] = [None] * replica_count
+        self.replicas: List[Optional[Replica]] = [None] * total
         self.sm_backend = sm_backend
-        for i in range(replica_count):
+        for i in range(total):
             Replica.format(self.storages[i], self.zone, self.cluster_id, i, replica_count)
             self._boot(i)
         self.clients = {
@@ -209,14 +212,54 @@ class Cluster:
             cluster=self.cluster_id,
             replica_index=i,
             replica_count=self.replica_count,
+            standby_count=self.standby_count,
             storage=self.storages[i],
             zone=self.zone,
             config=self.config,
             bus=_ReplicaBus(self.net, i),
             sm_backend=self.sm_backend,
+            on_event=self._on_replica_event,
         )
         r.open()
         self.replicas[i] = r
+
+    def _on_replica_event(self, kind: str, r: Replica) -> None:
+        if kind == "retired":
+            # A raced restart of a replaced member: it halts itself on
+            # committing the RECONFIGURE; drop it from routing.
+            ix = next(
+                (i for i, obj in enumerate(self.replicas) if obj is r), None
+            )
+            if ix is not None:
+                self.replicas[ix] = None
+            return
+        if kind != "promoted":
+            return
+        # A standby adopted a vacated active slot: re-home it (and its
+        # storage) so index-addressed routing reaches it at the new slot
+        # (a real deployment re-points the slot's address at the standby).
+        old = next(i for i, obj in enumerate(self.replicas) if obj is r)
+        target = r.replica
+        self.replicas[target] = r
+        self.storages[target] = self.storages[old]
+        self.replicas[old] = None
+        r.bus.me = ("replica", target)
+        # The slot is alive again (the standby answers for it now).
+        self.net.crashed.discard(("replica", target))
+
+    def reconfigure_promote(self, standby_index: int, target_index: int) -> None:
+        """Operator action: ask the cluster to promote a standby into a
+        vacated active slot (committed through the normal VSR path)."""
+        body = np.zeros(1, dtype=hdr.RECONFIGURE_DTYPE)
+        body[0]["standby_index"] = standby_index
+        body[0]["target_index"] = target_index
+        req = hdr.make(
+            Command.REQUEST, self.cluster_id, operation=Operation.RECONFIGURE,
+        )
+        msg = Message(req, body.tobytes()).seal()
+        for i, r in enumerate(self.replicas):
+            if r is not None and not r.is_standby:
+                self.net.send(("client", 0), ("replica", i), msg.to_bytes())
 
     # --- fault injection -----------------------------------------------
 
@@ -229,6 +272,8 @@ class Cluster:
         self.replicas[i] = None
 
     def restart_replica(self, i: int) -> None:
+        if self.replicas[i] is not None:
+            return  # slot already live (e.g. a standby promoted into it)
         self.net.crashed.discard(("replica", i))
         self._boot(i)
 

@@ -57,6 +57,14 @@ class Auditor:
             self._apply(op, operation, body, results, timestamp)
             self._applied_op = op
 
+    def note_control_op(self, op: int) -> None:
+        """A committed client-less control op (RECONFIGURE) occupies an op
+        number but produces no reply — acknowledge the gap so the in-order
+        drain can pass it."""
+        if op == self._applied_op + 1:
+            self._applied_op = op
+            self._drain()
+
     def _apply(self, op: int, operation: int, body: bytes, results: bytes, ts: int) -> None:
         orc = self.oracle
         if operation == Operation.REGISTER:
@@ -239,6 +247,23 @@ class Workload:
     # --- driving --------------------------------------------------------
 
     def tick(self) -> None:
+        # Control-op gap detection: a committed RECONFIGURE has no client
+        # reply; read it from any live replica's journal and acknowledge
+        # the op number so the auditor's drain can pass it. (Clusters
+        # without standbys can never commit one — skip the per-tick probe.)
+        if getattr(self.cluster, "standby_count", 0):
+            nxt = self.auditor._applied_op + 1
+            for r in self.cluster.replicas:
+                if r is None or r.commit_min < nxt:
+                    continue
+                m = r.journal.read_prepare(nxt)
+                if (
+                    m is not None
+                    and m.header["client"] == 0
+                    and m.header["operation"] == Operation.RECONFIGURE
+                ):
+                    self.auditor.note_control_op(nxt)
+                break
         for client in self.cluster.clients.values():
             if not client.registered or not client.idle:
                 continue

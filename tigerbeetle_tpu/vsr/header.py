@@ -67,6 +67,12 @@ class Operation:
 
     ROOT = 1
     REGISTER = 2
+    # Membership change (reference vsr.Operation.reconfigure +
+    # commit_reconfiguration, replica.zig:3842): body is RECONFIGURE_DTYPE
+    # — promote one standby into a vacated active slot, committed through
+    # the normal replication path so every replica applies it at the same
+    # op.
+    RECONFIGURE = 3
 
     CREATE_ACCOUNTS = 128
     CREATE_TRANSFERS = 129
@@ -84,6 +90,13 @@ class Operation:
         "get_account_history": 133,
     }
 
+
+# RECONFIGURE operation body: promote standby_index into active slot
+# target_index (vacated by a failed member).
+RECONFIGURE_DTYPE = np.dtype(
+    [("standby_index", "<u4"), ("target_index", "<u4"), ("reserved", "V24")]
+)
+assert RECONFIGURE_DTYPE.itemsize == 32
 
 # One layout for all commands; per-command fields are a documented union in
 # the reference — here the superset is flattened (256 B total, zero-padded).

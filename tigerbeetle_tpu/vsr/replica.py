@@ -36,7 +36,9 @@ from tigerbeetle_tpu.models.state_machine import StateMachine
 from tigerbeetle_tpu.vsr import header as hdr
 from tigerbeetle_tpu.vsr import snapshot
 from tigerbeetle_tpu.vsr.clock import Clock, DeterministicTime
-from tigerbeetle_tpu.vsr.header import Command, Header, Message, Operation
+from tigerbeetle_tpu.vsr.header import (
+    Command, Header, Message, Operation, RECONFIGURE_DTYPE,
+)
 from tigerbeetle_tpu.vsr.journal import Journal
 from tigerbeetle_tpu.vsr.superblock import NO_TRAILER, SuperBlock, VSRState
 
@@ -109,10 +111,24 @@ class Replica:
         on_event: Optional[Callable[[str, "Replica"], None]] = None,
         time=None,
         aof=None,
+        standby_count: int = 0,
     ) -> None:
         self.cluster = cluster
         self.replica = replica_index
         self.replica_count = replica_count
+        # Standbys (reference constants.zig:33, ≤6): replica indexes
+        # [replica_count, replica_count+standby_count) replicate passively —
+        # they journal + commit every prepare but never ack, vote, or count
+        # toward any quorum. A committed RECONFIGURE op promotes one into a
+        # vacated active slot (reference commit_reconfiguration,
+        # replica.zig:3842 — a stub there; a working promotion path here).
+        self.standby_count = standby_count
+        # Set when a committed RECONFIGURE reassigned this replica's slot
+        # while it was down: the node must never participate again.
+        self.retired = False
+        # (standby, target) pairs whose RECONFIGURE this replica has
+        # committed — primary-side dedupe of duplicate operator requests.
+        self.reconfigures_applied: set = set()
         self.config = config
         self.storage = storage
         self.zone = zone
@@ -228,6 +244,10 @@ class Replica:
         return view % self.replica_count
 
     @property
+    def is_standby(self) -> bool:
+        return self.replica >= self.replica_count
+
+    @property
     def is_primary(self) -> bool:
         return self.status == STATUS_NORMAL and self.primary_index(self.view) == self.replica
 
@@ -332,6 +352,8 @@ class Replica:
     # ticks / timeouts
 
     def tick(self) -> None:
+        if self.retired:
+            return
         self.tick_count += 1
         if hasattr(self.time, "tick"):
             self.time.tick()  # replica-owned deterministic time
@@ -380,6 +402,8 @@ class Replica:
     # message dispatch
 
     def on_message(self, msg: Message) -> None:
+        if self.retired:
+            return
         if not msg.verify():
             return
         h = msg.header
@@ -455,6 +479,28 @@ class Replica:
         client = h["client"]
         sess = self.clients.get(client)
 
+        if h["operation"] == Operation.RECONFIGURE:
+            # Operator-issued membership change (client 0, no session):
+            # dedupe against in-flight AND already-applied copies, then
+            # commit like any op. (Commit is idempotent regardless — the
+            # promoted_at_op guard makes duplicates no-ops — this just
+            # avoids wasting ops.)
+            rec = np.frombuffer(msg.body, dtype=RECONFIGURE_DTYPE)
+            pair = (
+                (int(rec[0]["standby_index"]), int(rec[0]["target_index"]))
+                if len(rec) else None
+            )
+            inflight = any(
+                e.message.header["operation"] == Operation.RECONFIGURE
+                for e in self.pipeline
+            ) or any(
+                q.header["operation"] == Operation.RECONFIGURE
+                for q in self.request_queue
+            )
+            if not inflight and pair not in self.reconfigures_applied:
+                self._append_request(msg)
+            return
+
         if h["operation"] == Operation.REGISTER:
             if sess is None:
                 # Session is created when the register op COMMITS (it is
@@ -512,6 +558,9 @@ class Replica:
                 return False
         elif operation == Operation.REGISTER:
             if len(body) != 0:
+                return False
+        elif operation == Operation.RECONFIGURE:
+            if len(body) != RECONFIGURE_DTYPE.itemsize:
                 return False
         else:
             return False
@@ -723,15 +772,28 @@ class Replica:
         missing; once quorum commits (and the pipeline entry pops), a
         still-missing tail replica catches up via the commit heartbeat →
         _repair_gaps → REQUEST_PREPARE path instead."""
-        if self.replica_count <= 1:
+        total = self.replica_count + self.standby_count
+        if total <= 1:
+            return
+        if self.is_standby:
+            # Standby sub-chain: forward to the next standby, if any.
+            if self.replica + 1 < total:
+                self.bus.send_to_replica(self.replica + 1, prepare)
             return
         v = prepare.header["view"]
         pos = (self.replica - self.primary_index(v)) % self.replica_count
         if pos + 1 >= self.replica_count:
-            return  # chain tail: the next hop would be the primary
+            # Active-chain tail: instead of wrapping to the primary, extend
+            # the chain into the standbys (reference: standbys sit at the
+            # end of the replication chain).
+            if self.standby_count:
+                self.bus.send_to_replica(self.replica_count, prepare)
+            return
         self.bus.send_to_replica((self.replica + 1) % self.replica_count, prepare)
 
     def _send_prepare_ok(self, prepare_header: Header) -> None:
+        if self.is_standby:
+            return  # passive: journals + commits, never acks toward quorum
         ok = hdr.make(
             Command.PREPARE_OK, self.cluster,
             view=self.view, op=prepare_header["op"],
@@ -778,7 +840,7 @@ class Replica:
             view=self.view, commit=self.commit_min, replica=self.replica,
         )
         m = Message(ch).seal()
-        for r in range(self.replica_count):
+        for r in range(self.replica_count + self.standby_count):
             if r != self.replica:
                 self.bus.send_to_replica(r, m)
 
@@ -1384,6 +1446,11 @@ class Replica:
         heartbeats and its view would run away past the live cluster's,
         wedging it permanently (observed at VOPR seed 142)."""
         self.last_heartbeat_tick = self.tick_count
+        if self.is_standby:
+            # Standbys neither vote nor count toward view-change quorums;
+            # they follow completed view changes via START_VIEW /
+            # prepare-view catch-up.
+            return
         svc = hdr.make(
             Command.START_VIEW_CHANGE, self.cluster,
             view=new_view, replica=self.replica,
@@ -1757,6 +1824,56 @@ class Replica:
                 )
             else:
                 results = b""
+        elif operation == Operation.RECONFIGURE:
+            results = b""
+            rec = np.frombuffer(body, dtype=RECONFIGURE_DTYPE)
+            if len(rec):
+                standby_ix = int(rec[0]["standby_index"])
+                target_ix = int(rec[0]["target_index"])
+                if (
+                    self.replica_count <= standby_ix
+                    < self.replica_count + self.standby_count
+                    and 0 <= target_ix < self.replica_count
+                ):
+                    tracer.count("mark.reconfigure_commit")
+                    self.reconfigures_applied.add((standby_ix, target_ix))
+                    if self.is_standby and self.replica == standby_ix:
+                        # THIS standby takes over the vacated active slot:
+                        # adopt the identity durably (the superblock is the
+                        # identity of the data file — a restart must come
+                        # back as the active member), then start acking.
+                        log.info(
+                            "replica %d: promoted standby -> active slot %d",
+                            self.replica, target_ix,
+                        )
+                        self.replica = target_ix
+                        self.superblock.state.replica = target_ix
+                        self.superblock.state.promoted_at_op = op_num
+                        self.superblock.checkpoint()
+                        self.on_event("promoted", self)
+                    elif (
+                        not self.is_standby
+                        and self.replica == target_ix
+                        and self.superblock.state.promoted_at_op == 0
+                    ):
+                        # The cluster gave OUR slot away (we were presumed
+                        # dead; a raced restart must not split-brain the
+                        # slot): retire permanently (reference epoch
+                        # semantics; operator decommissions the node).
+                        # promoted_at_op != 0 means WE are the promoted
+                        # occupant — a duplicate committed RECONFIGURE
+                        # must be a no-op, never self-retirement. (A
+                        # SECOND promotion chain into the same slot is an
+                        # operator-contract limitation, as in the
+                        # reference's reconfiguration stub.)
+                        log.warning(
+                            "replica %d: slot reassigned by reconfiguration "
+                            "at op %d — retiring", self.replica, op_num,
+                        )
+                        tracer.count("mark.replica_retired")
+                        self.retired = True
+                        self.status = STATUS_RECOVERING
+                        self.on_event("retired", self)
         else:
             results = b""  # register / root
 

@@ -50,7 +50,11 @@ SUPERBLOCK_DTYPE = np.dtype(
         # missing — the replica must finish fetching them before serving
         # (reference sync.zig SyncStage persistence).
         ("sync_pending", "<u4"),
-        ("reserved", "V376"),
+        # The op of the RECONFIGURE that promoted this replica out of
+        # standby (0 = never promoted): replaying that op must not make
+        # the promoted replica retire itself from its own slot.
+        ("promoted_at_op", "<u8"),
+        ("reserved", "V368"),
     ]
 )
 assert SUPERBLOCK_DTYPE.itemsize == 512
@@ -75,6 +79,7 @@ class VSRState:
     parent: int = 0
     trailer_block: int = 0xFFFFFFFF  # NO_TRAILER
     sync_pending: int = 0
+    promoted_at_op: int = 0
     sequence: int = field(default=0)
 
 
@@ -106,6 +111,7 @@ class SuperBlock:
         rec["parent_hi"] = s.parent >> 64
         rec["trailer_block"] = s.trailer_block
         rec["sync_pending"] = s.sync_pending
+        rec["promoted_at_op"] = s.promoted_at_op
         c = checksum(rec.tobytes()[16:])
         rec["checksum_lo"] = c & ((1 << 64) - 1)
         rec["checksum_hi"] = c >> 64
@@ -134,6 +140,7 @@ class SuperBlock:
             parent=int(rec["parent_lo"]) | (int(rec["parent_hi"]) << 64),
             trailer_block=int(rec["trailer_block"]),
             sync_pending=int(rec["sync_pending"]),
+            promoted_at_op=int(rec["promoted_at_op"]),
             sequence=int(rec["sequence"]),
         )
 
