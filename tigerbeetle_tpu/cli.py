@@ -82,7 +82,12 @@ def cmd_start(args) -> int:
     # Standbys (reference standbys, constants.zig:33): addresses beyond
     # --active-count are passive replicas at the chain tail.
     active = args.active_count if args.active_count else len(addresses)
-    assert 1 <= active <= len(addresses)
+    if not 1 <= active <= len(addresses):
+        print(
+            f"error: --active-count={active} must be between 1 and the "
+            f"number of addresses ({len(addresses)})", file=sys.stderr,
+        )
+        return 2
     replica = Replica(
         cluster=args.cluster,
         replica_index=args.replica,
@@ -279,30 +284,24 @@ def cmd_benchmark(args) -> int:
                 res = client.create_accounts(ev)
                 assert len(res) == 0
 
-            # Concurrent clients (reference: clients_max sessions, each one
-            # request in flight) keep the primary's 8-deep prepare pipeline
-            # fed — one synchronous client leaves the server idle while the
-            # next batch marshals.
-            import threading
+            # Pipelined load via the AsyncClient session pool (reference
+            # benchmark_load.zig drives the client's 32-deep request queue):
+            # one thread, N concurrent sessions keep the primary's 8-deep
+            # prepare pipeline and the WAL group-commit batcher fed.
+            from tigerbeetle_tpu.client import AsyncClient
 
-            n_clients = max(1, args.clients)
-            extra = [client] + [
-                Client([("127.0.0.1", port)]) for _ in range(n_clients - 1)
-            ]
-            lat = []
-            lat_lock = threading.Lock()
-            share = args.transfers // n_clients
+            n_sessions = max(1, args.clients)
 
-            def gen_batches(ci: int) -> list:
-                """Pre-stage this client's batches (load generation is not
-                part of the measured pipeline; serialization, checksum,
-                and the wire are)."""
-                rng = np.random.default_rng(0xBEE + ci)
-                next_id = 1 + ci * args.transfers  # id spaces disjoint
+            def gen_batches() -> list:
+                """Pre-stage batches (load generation is not part of the
+                measured pipeline; serialization, checksum, and the wire
+                are)."""
+                rng = np.random.default_rng(0xBEE)
+                next_id = 1
                 out = []
                 sent = 0
-                while sent < share:
-                    n = min(batch, share - sent)
+                while sent < args.transfers:
+                    n = min(batch, args.transfers - sent)
                     ev = np.zeros(n, dtype=types.TRANSFER_DTYPE)
                     ev["id_lo"] = np.arange(next_id, next_id + n, dtype=np.uint64)
                     next_id += n
@@ -318,26 +317,22 @@ def cmd_benchmark(args) -> int:
                     sent += n
                 return out
 
-            staged = [gen_batches(ci) for ci in range(n_clients)]
+            staged = gen_batches()
+            lat: list = []
 
-            def load(ci: int, cl: "Client") -> None:
-                for ev in staged[ci]:
-                    b0 = time.perf_counter()
-                    cl.create_transfers(ev)
-                    with lat_lock:
-                        lat.append(time.perf_counter() - b0)
+            async def run_load() -> float:
+                async with AsyncClient(
+                    [("127.0.0.1", port)], sessions=n_sessions
+                ) as ac:
+                    ac.latencies = lat  # service latency (send → reply)
+                    t0 = time.perf_counter()
+                    await asyncio.gather(
+                        *[ac.create_transfers(ev) for ev in staged]
+                    )
+                    return time.perf_counter() - t0
 
-            t0 = time.perf_counter()
-            threads = [
-                threading.Thread(target=load, args=(ci, cl))
-                for ci, cl in enumerate(extra)
-            ]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
-            sent = share * n_clients
-            dt = time.perf_counter() - t0
+            dt = asyncio.run(run_load())
+            sent = sum(len(ev) for ev in staged)
             rng = np.random.default_rng(0xBEE)
             lat.sort()
             print(f"load accepted = {sent / dt:,.0f} tx/s")

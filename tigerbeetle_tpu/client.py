@@ -1,14 +1,18 @@
-"""Synchronous Python client library.
+"""Client libraries: synchronous (scripts/REPL) and async pipelined.
 
 The user-facing API (the role of /root/reference/src/clients/* and
-src/vsr/client.zig:20): session registration, one request in flight,
-automatic primary discovery and resend, typed batch submission. Blocking
-socket implementation — suitable for scripts, the REPL, and the benchmark;
-an async variant can wrap the same framing.
+src/vsr/client.zig:20): session registration, one request in flight PER
+SESSION (the VSR session contract), automatic primary discovery and
+resend, typed batch submission. `Client` is the blocking-socket variant;
+`AsyncClient` multiplexes a pool of sessions over one asyncio loop with a
+bounded submission queue — the pipelining feature of the reference's
+client (client.zig:26-60 queues 32 requests) expressed across sessions,
+keeping the primary's 8-deep prepare pipeline fed from a single thread.
 """
 
 from __future__ import annotations
 
+import asyncio
 import secrets
 import socket
 import time
@@ -37,8 +41,12 @@ class Client:
         addresses: Sequence[Tuple[str, int]],
         cluster: int = 0,
         client_id: Optional[int] = None,
+        active_count: Optional[int] = None,
     ) -> None:
         self.addresses = list(addresses)
+        # Active replica count — addresses past it are standbys; the
+        # view's primary is view % ACTIVE count.
+        self.active = active_count if active_count else len(addresses)
         self.cluster = cluster
         self.id = client_id if client_id is not None else secrets.randbits(127) | 1
         self.request_number = 0
@@ -163,7 +171,7 @@ class Client:
                             if h["command"] == Command.PONG_CLIENT:
                                 # Hello answer: aim at the view's primary
                                 # (reference client view discovery).
-                                self._target = h["view"] % len(self.addresses)
+                                self._target = h["view"] % self.active
                                 continue
                             if h["command"] == Command.EVICTION:
                                 # The session is gone server-side; allow a
@@ -288,3 +296,210 @@ class Client:
             self._filter_body(account_id, timestamp_min, timestamp_max, limit, flags),
         )
         return np.frombuffer(bytearray(reply.body), dtype=types.ACCOUNT_BALANCE_DTYPE)
+
+
+class AsyncClient:
+    """Pipelined asyncio client: a pool of VSR sessions over one loop.
+
+    Each session honors the protocol's one-request-in-flight contract;
+    throughput pipelining comes from running `sessions` of them
+    concurrently (the reference's tb_client likewise multiplexes packets
+    onto sessions from one IO thread). `submit` returns once a session is
+    free and the request is on the wire; the result future resolves on
+    the demuxed reply.
+
+        async with AsyncClient(addrs, sessions=8) as c:
+            results = await c.create_transfers(batch)
+    """
+
+    REQUEST_TIMEOUT = 2.0
+
+    def __init__(
+        self,
+        addresses: Sequence[Tuple[str, int]],
+        cluster: int = 0,
+        sessions: int = 8,
+        active_count: Optional[int] = None,
+    ) -> None:
+        self.addresses = list(addresses)
+        self.cluster = cluster
+        self.n_sessions = sessions
+        # Active replica count (addresses beyond it are standbys): the
+        # view's primary is view % ACTIVE count, not % len(addresses).
+        self.active = active_count if active_count else len(addresses)
+        self._sessions: List[dict] = []
+        self._free: asyncio.Queue = asyncio.Queue()
+        self._by_client: dict[int, dict] = {}
+        self._writers: dict[int, asyncio.StreamWriter] = {}
+        self._readers: dict[int, asyncio.Task] = {}
+        self._target = 0
+        self._started = False
+        # Per-request SERVICE latency (send → demuxed reply, excluding
+        # session-pool queueing) — what the reference's batch-latency
+        # histogram measures.
+        self.latencies: List[float] = []
+
+    async def __aenter__(self) -> "AsyncClient":
+        await self.start()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    async def _connect(self, r: int) -> Optional[asyncio.StreamWriter]:
+        host, port = self.addresses[r]
+        try:
+            reader, writer = await asyncio.open_connection(host, port)
+        except OSError:
+            return None
+        self._writers[r] = writer
+        self._readers[r] = asyncio.ensure_future(self._read_loop(r, reader))
+        # Announce every session id on this connection so ANY replica can
+        # route replies to us — a reply may come from the primary even
+        # when the request went through a forwarding backup/standby.
+        try:
+            for sess in self._sessions:
+                hello = hdr.make(
+                    Command.PING_CLIENT, self.cluster, client=sess["client"]
+                )
+                writer.write(Message(hello).seal().to_bytes())
+            await writer.drain()
+        except OSError:
+            self._writers.pop(r, None)
+            return None
+        return writer
+
+    async def _read_loop(self, r: int, reader: asyncio.StreamReader) -> None:
+        from tigerbeetle_tpu.net.bus import read_message
+
+        while True:
+            msg = await read_message(reader)
+            if msg is None:
+                self._writers.pop(r, None)
+                return
+            h = msg.header
+            cmd = h["command"]
+            if cmd == Command.PONG_CLIENT:
+                self._target = h["view"] % self.active
+                continue
+            if cmd == Command.EVICTION:
+                # The server's client table overflowed (sessions >
+                # clients_max): fail the session loudly instead of letting
+                # its requests time out silently.
+                sess = self._by_client.get(h["client"])
+                if sess is not None and sess["inflight"] is not None:
+                    fut = sess["inflight"]
+                    sess["inflight"] = None
+                    if not fut.done():
+                        fut.set_exception(SessionEvicted(
+                            "session evicted (pool larger than the "
+                            "cluster's clients_max?)"
+                        ))
+                continue
+            if cmd == Command.REPLY:
+                sess = self._by_client.get(h["client"])
+                if (
+                    sess is not None
+                    and sess["inflight"] is not None
+                    and h["request"] == sess["request"]
+                ):
+                    fut = sess["inflight"]
+                    sess["inflight"] = None
+                    if not fut.done():
+                        fut.set_result(msg)
+                    self._target = h["replica"]
+
+    async def _send(self, r: int, msg: Message, body) -> bool:
+        w = self._writers.get(r) or await self._connect(r)
+        if w is None:
+            return False
+        try:
+            w.write(msg.header.to_bytes())
+            nb = body.nbytes if isinstance(body, np.ndarray) else len(body)
+            if nb:
+                w.write(memoryview(body).cast("B"))
+            await w.drain()
+            return True
+        except OSError:
+            self._writers.pop(r, None)
+            return False
+
+    async def start(self) -> None:
+        assert not self._started
+        self._started = True
+        # Create the session pool FIRST so _connect's hellos announce
+        # every session id on every connection.
+        for _ in range(self.n_sessions):
+            sess = {
+                "client": secrets.randbits(127) | 1, "request": 0,
+                "inflight": None,
+            }
+            self._sessions.append(sess)
+            self._by_client[sess["client"]] = sess
+        for r in range(len(self.addresses)):
+            await self._connect(r)
+        # Register every session (each is an independent VSR client), then
+        # release them into the pool.
+        for sess in self._sessions:
+            await self._request(sess, Operation.REGISTER, b"")
+            await self._free.put(sess)
+
+    async def _request(self, sess: dict, operation: int, body) -> Message:
+        sess["request"] += 1
+        req = hdr.make(
+            Command.REQUEST, self.cluster,
+            client=sess["client"], request=sess["request"], operation=operation,
+        )
+        msg = Message(req, body).seal()
+        loop = asyncio.get_running_loop()
+        deadline_rotations = 4 * len(self.addresses) + 4
+        t0 = time.perf_counter()
+        try:
+            for _ in range(deadline_rotations):
+                fut = loop.create_future()
+                sess["inflight"] = fut
+                if not await self._send(self._target % len(self.addresses), msg, body):
+                    self._target += 1
+                    continue
+                try:
+                    reply = await asyncio.wait_for(fut, self.REQUEST_TIMEOUT)
+                    self.latencies.append(time.perf_counter() - t0)
+                    return reply
+                except asyncio.TimeoutError:
+                    self._target += 1  # rotate replicas and resend
+            raise ClientError("request timed out against every replica")
+        finally:
+            sess["inflight"] = None
+
+    async def submit(self, operation: int, body) -> Message:
+        """Queue-bounded pipelined submission: waits for a free session,
+        sends, resolves on the demuxed reply. The session returns to the
+        pool on completion (success or failure) — submit owns its
+        lifecycle."""
+        sess = await self._free.get()
+        try:
+            return await self._request(sess, operation, body)
+        finally:
+            await self._free.put(sess)
+
+    async def create_transfers(self, transfers: np.ndarray) -> np.ndarray:
+        reply = await self.submit(
+            Operation.CREATE_TRANSFERS, np.ascontiguousarray(transfers)
+        )
+        return np.frombuffer(bytearray(reply.body), dtype=types.EVENT_RESULT_DTYPE)
+
+    async def create_accounts(self, accounts: np.ndarray) -> np.ndarray:
+        reply = await self.submit(
+            Operation.CREATE_ACCOUNTS, np.ascontiguousarray(accounts)
+        )
+        return np.frombuffer(bytearray(reply.body), dtype=types.EVENT_RESULT_DTYPE)
+
+    async def close(self) -> None:
+        for t in self._readers.values():
+            t.cancel()
+        for w in self._writers.values():
+            try:
+                w.close()
+            except OSError:
+                pass
+        self._writers = {}

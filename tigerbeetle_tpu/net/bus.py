@@ -230,7 +230,9 @@ class ReplicaServer:
     ) -> None:
         conn = _Conn(writer)
         peer_replica: Optional[int] = None
-        client_id: Optional[int] = None
+        # One connection may carry MANY client sessions (AsyncClient
+        # multiplexes its session pool over a single socket) — map each.
+        client_ids: set[int] = set()
         while not self._stopping.is_set():
             msg = await read_message(reader)
             if msg is None:
@@ -240,8 +242,8 @@ class ReplicaServer:
             if cmd == Command.PING_CLIENT and h["client"] != 0:
                 # Explicit client hello: always (re)map — this connection IS
                 # the client, and must win over any stale/forwarded mapping.
-                client_id = h["client"]
-                self.client_conns[client_id] = conn
+                client_ids.add(h["client"])
+                self.client_conns[h["client"]] = conn
                 # Answer with the current view so the client can aim its
                 # first request at the primary instead of trial-rotating
                 # (reference ping_client/pong_client, vsr/client.zig view
@@ -249,7 +251,7 @@ class ReplicaServer:
                 r = self.replica
                 pong = Header(
                     None, command=Command.PONG_CLIENT, cluster=r.cluster,
-                    replica=self.me_index, view=r.view, client=client_id,
+                    replica=self.me_index, view=r.view, client=h["client"],
                 )
                 conn.send(Message(pong).seal().to_bytes())
                 continue  # hello is transport-level, not for the replica
@@ -257,9 +259,9 @@ class ReplicaServer:
                 # Map only direct client connections: a REQUEST arriving on
                 # an identified peer connection was *forwarded* by a backup
                 # and must not steal the client's reply route.
-                if peer_replica is None and client_id is None and h["client"] != 0:
-                    client_id = h["client"]
-                    self.client_conns.setdefault(client_id, conn)
+                if peer_replica is None and h["client"] != 0:
+                    client_ids.add(h["client"])
+                    self.client_conns.setdefault(h["client"], conn)
             elif h["replica"] != self.me_index:
                 r = h["replica"]
                 if cmd == Command.PING:
@@ -275,8 +277,9 @@ class ReplicaServer:
                     peer_replica = r
                     self.peer_conns.setdefault(r, conn)
             self._dispatch(msg)
-        if client_id is not None and self.client_conns.get(client_id) is conn:
-            del self.client_conns[client_id]
+        for cid in client_ids:
+            if self.client_conns.get(cid) is conn:
+                del self.client_conns[cid]
         if peer_replica is not None and self.peer_conns.get(peer_replica) is conn:
             del self.peer_conns[peer_replica]
         writer.close()

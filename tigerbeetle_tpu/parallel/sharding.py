@@ -251,6 +251,10 @@ def make_sharded_commit_exact(mesh: Mesh, accounts_max: int, with_plan: bool = F
         return commit_exact.create_transfers_exact_impl(
             state, b, host_code, pending, chain_id, plan,
             balance_read=balance_read, balance_apply=balance_apply,
+            # dp-shard the per-sweep MXU cumsums (bit-identical: u32 adds
+            # are associative; cross-slice offsets + result ride
+            # all_gathers over ICI). With dp=1 this is a no-op.
+            cumsum_axis="dp" if mesh.shape["dp"] > 1 else None,
         )
 
     obs_spec = Observed(*([P()] * 4))

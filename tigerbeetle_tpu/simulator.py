@@ -148,6 +148,7 @@ class Simulator:
                 s_ix, target = self.promote_at[tick]
                 if target in down and cl.replicas[s_ix] is not None:
                     self.promote_pending = (s_ix, target)
+                    cl.reconfigure_promote(s_ix, target)  # issue NOW
                     self.log.append(
                         (tick, f"promote standby {s_ix} -> slot {target}")
                     )
