@@ -570,12 +570,15 @@ def main() -> None:
     t_start = time.perf_counter()
     results = {}
     for name, fn in (
+        # End-to-end FIRST: it forks a server+client pair onto this host's
+        # single core, and the parent must not yet hold jax runtime
+        # threads (device dispatch/tunnel keepalive) competing for it.
+        ("end_to_end", bench_e2e),
         ("config1_default", bench_config1),
         ("config2_zipf", bench_config2_zipf),
         ("config3_linked_pending", lambda: bench_exact("config3")),
         ("config4_balancing_limits", lambda: bench_exact("config4")),
         ("config5_lsm", bench_config5_lsm),
-        ("end_to_end", bench_e2e),
     ):
         try:
             results[name] = fn()

@@ -443,10 +443,11 @@ def main(argv=None) -> int:
     b.add_argument("--transfers", type=int, default=100_000)
     b.add_argument("--batch", type=int, default=8190)
     b.add_argument("--port", type=int, default=3001)
-    # >1 keeps the primary's prepare pipeline (and the WAL group-commit
-    # batcher) fed — the default measures pipelined throughput; use
-    # --clients=1 for clean single-client latency.
-    b.add_argument("--clients", type=int, default=4)
+    # Session-pool depth for the pipelined AsyncClient: >1 keeps the
+    # primary's prepare pipeline (and the WAL group-commit batcher) fed —
+    # the default measures pipelined throughput; use --clients=1 for clean
+    # single-request latency.
+    b.add_argument("--clients", type=int, default=6)
     b.add_argument("--queries", type=int, default=100)
     b.add_argument("--config", default="production")
     b.add_argument("--backend", default="jax", choices=["jax", "numpy"])
