@@ -432,6 +432,85 @@ class TestReadOps:
                 for rec, t in zip(got, want):
                     assert transfer_from_numpy(rec) == t
 
+    def test_get_account_transfers_timestamp_window(self):
+        """timestamp_min/max windows + limit + REVERSED, vs the oracle
+        (reference AccountFilter semantics, tigerbeetle.zig:268)."""
+        accounts = simple_accounts(3)
+        transfers = types.batch(
+            [
+                types.transfer(id=i + 1, debit_account_id=1 + (i % 2),
+                               credit_account_id=3, amount=i + 1, ledger=1, code=1)
+                for i in range(12)
+            ],
+            types.TRANSFER_DTYPE,
+        )
+        sm, orc = run_both([accounts], [transfers])
+        from tigerbeetle_tpu.flags import AccountFilterFlags as FF
+
+        all_ts = sorted(
+            int(t["timestamp"]) for t in sm.get_account_transfers(3, limit=100)
+        )
+        assert len(all_ts) == 12
+        lo, hi = all_ts[3], all_ts[8]
+        for ts_min, ts_max in ((lo, hi), (0, hi), (lo, 0), (hi, lo)):
+            for flags in (FF.DEBITS | FF.CREDITS,
+                          FF.DEBITS | FF.CREDITS | FF.REVERSED):
+                for limit in (2, 100):
+                    got = sm.get_account_transfers(
+                        3, timestamp_min=ts_min, timestamp_max=ts_max,
+                        limit=limit, flags=int(flags),
+                    )
+                    want = orc.get_account_transfers(
+                        3, timestamp_min=ts_min, timestamp_max=ts_max,
+                        limit=limit, flags=int(flags),
+                    )
+                    assert len(got) == len(want), (ts_min, ts_max, flags, limit)
+                    for rec, t in zip(got, want):
+                        assert transfer_from_numpy(rec) == t
+
+    def test_get_account_history_filters(self):
+        """History filter axes (window/limit/REVERSED/side flags) vs the
+        oracle, over the durable history groove."""
+        from tigerbeetle_tpu.flags import AccountFlags
+        from tigerbeetle_tpu.flags import AccountFilterFlags as FF
+
+        accounts = types.batch(
+            [
+                types.account(id=1, ledger=1, code=10,
+                              flags=int(AccountFlags.HISTORY)),
+                types.account(id=2, ledger=1, code=10),
+                types.account(id=3, ledger=1, code=10,
+                              flags=int(AccountFlags.HISTORY)),
+            ],
+            types.ACCOUNT_DTYPE,
+        )
+        transfers = types.batch(
+            [
+                types.transfer(id=i + 1, debit_account_id=1 + (i % 2),
+                               credit_account_id=3, amount=5 + i, ledger=1, code=1)
+                for i in range(10)
+            ],
+            types.TRANSFER_DTYPE,
+        )
+        sm, orc = run_both([accounts], [transfers])
+        rows = sm.get_account_history(1)
+        assert len(rows) == len(orc.get_account_history(1)) > 0
+        ts_mid = rows[len(rows) // 2][0]
+        for aid in (1, 2, 3):
+            for ts_min, ts_max in ((0, 0), (ts_mid, 0), (0, ts_mid)):
+                for flags in (FF.DEBITS, FF.CREDITS, FF.DEBITS | FF.CREDITS,
+                              FF.DEBITS | FF.CREDITS | FF.REVERSED):
+                    for limit in (3, 100):
+                        got = sm.get_account_history(
+                            aid, timestamp_min=ts_min, timestamp_max=ts_max,
+                            limit=limit, flags=int(flags),
+                        )
+                        want = orc.get_account_history(
+                            aid, timestamp_min=ts_min, timestamp_max=ts_max,
+                            limit=limit, flags=int(flags),
+                        )
+                        assert got == want, (aid, ts_min, ts_max, flags, limit)
+
     def test_lookup_missing(self):
         sm = StateMachine(CFG)
         out = sm.lookup_accounts(np.array([5], dtype=np.uint64), np.array([0], dtype=np.uint64))

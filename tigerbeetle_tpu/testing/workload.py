@@ -135,6 +135,14 @@ class Workload:
         self.max_batch = max_batch
         self.largest_batch = 0  # observed, for big-batch schedule asserts
         self.next_transfer_id = 1
+        # Reversible id permutation (reference testing/id.zig): wire ids
+        # are encode(seq) — diverse bit patterns hit the id indexes/bloom,
+        # while the sequence stays decodable for duplicates and lookups.
+        # Picked from a DERIVED rng so existing seeds' schedules (which
+        # tests pin) keep their main random stream.
+        from tigerbeetle_tpu.testing import id as id_mod
+
+        self.id_perm = id_mod.pick(random.Random(seed * 131 + 9))
         self.pending_ids: List[int] = []
         self.requests_done = 0
         self._accounts_created = False
@@ -203,7 +211,7 @@ class Workload:
             elif kind < 0.3:
                 flags = int(TransferFlags.PENDING)
                 timeout = rng.randint(0, 3)
-                self.pending_ids.append(self.next_transfer_id)
+                self.pending_ids.append(self._encode_id(self.next_transfer_id))
             elif kind < 0.4:
                 flags = int(
                     TransferFlags.BALANCING_DEBIT
@@ -212,10 +220,10 @@ class Workload:
                 )
             if rng.random() < 0.15:
                 flags |= int(TransferFlags.LINKED)
-            tid = self.next_transfer_id
             if rng.random() < 0.06 and self.next_transfer_id > 1:
-                tid = rng.randint(1, self.next_transfer_id - 1)
+                tid = self._encode_id(rng.randint(1, self.next_transfer_id - 1))
             else:
+                tid = self._encode_id(self.next_transfer_id)
                 self.next_transfer_id += 1
             recs.append(
                 types.transfer(
@@ -232,6 +240,16 @@ class Workload:
             )
         return types.batch(recs, types.TRANSFER_DTYPE).tobytes()
 
+    def _encode_id(self, seq: int) -> int:
+        """Wire id for a sequence number; never 0 (invalid on the wire —
+        only IdRandom can map a positive seq there; skip such seqs
+        deterministically)."""
+        enc = self.id_perm.encode(seq)
+        while enc == 0:
+            seq += 1 << 32  # outside the workload's seq range, stable
+            enc = self.id_perm.encode(seq)
+        return enc
+
     def _gen_lookup(self) -> Tuple[int, bytes]:
         rng = self.rng
         if rng.random() < 0.5:
@@ -241,7 +259,10 @@ class Workload:
             return Operation.LOOKUP_ACCOUNTS, arr.tobytes()
         k = rng.randint(1, 4)
         arr = np.zeros(k, dtype=types.ID_DTYPE)
-        arr["lo"] = [rng.randint(1, max(2, self.next_transfer_id)) for _ in range(k)]
+        arr["lo"] = [
+            self._encode_id(rng.randint(1, max(2, self.next_transfer_id)))
+            for _ in range(k)
+        ]
         return Operation.LOOKUP_TRANSFERS, arr.tobytes()
 
     # --- driving --------------------------------------------------------

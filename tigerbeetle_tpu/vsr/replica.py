@@ -129,6 +129,10 @@ class Replica:
         # (standby, target) pairs whose RECONFIGURE this replica has
         # committed — primary-side dedupe of duplicate operator requests.
         self.reconfigures_applied: set = set()
+        # Eviction decisions are deferred while ops at or below this floor
+        # (the suffix inherited at election) are uncommitted — set when
+        # becoming primary of a new view / opening.
+        self._eviction_floor = 0
         self.config = config
         self.storage = storage
         self.zone = zone
@@ -346,6 +350,9 @@ class Replica:
             self.recovering_since = self.tick_count
         if resume_block_sync is not None:
             self._begin_block_sync(resume_block_sync)
+        # Recovered journal ops not yet re-committed gate session judgement
+        # the same way a new primary's inherited suffix does.
+        self._eviction_floor = self.op
         self.on_event("open", self)
 
     # ------------------------------------------------------------------
@@ -517,6 +524,15 @@ class Replica:
             return
 
         if sess is None:
+            if self.commit_min < self._eviction_floor:
+                # A just-elected primary still committing the suffix it
+                # INHERITED from the previous view has a BEHIND client
+                # table — the session's register may be in those ops.
+                # Judging it now would evict a live client permanently
+                # (VOPR seed 227); drop instead, the client resends after
+                # catch-up. The floor is the election-time op, so steady-
+                # state pipelining never suppresses genuine evictions.
+                return
             evict = hdr.make(
                 Command.EVICTION, self.cluster, client=client,
                 replica=self.replica, view=self.view,
@@ -1637,6 +1653,10 @@ class Replica:
         self.log_view = v
         self.pipeline = []
         self.request_queue = []
+        # Session-judgement floor: ops inherited from the previous view may
+        # hold registers our client table hasn't applied yet — eviction
+        # decisions wait until they commit (see on_request).
+        self._eviction_floor = self.op
         self._persist_view()
         sv = hdr.make(
             Command.START_VIEW, self.cluster,
