@@ -1,0 +1,284 @@
+/* tb_client.c — C ABI client (see tb_client.h).
+ *
+ * The role of /root/reference/src/clients/c/tb_client.zig: a native
+ * client library with a stable C ABI that higher-level languages bind.
+ * Wire format is byte-identical to the Python client: 256-byte header
+ * (layout = tigerbeetle_tpu/vsr/header.py HEADER_DTYPE), AEGIS-128L MAC
+ * over header[16:] and over the body, command REQUEST, one session per
+ * handle with one request in flight (the VSR session contract;
+ * pipelining = multiple handles, as with AsyncClient's session pool).
+ *
+ * Build (test harness builds it automatically):
+ *   cc -O3 -maes -mssse3 -shared -fPIC tb_client.c -o libtbclient.so
+ * (aegis128l.c is #included for the MAC — one translation unit, no
+ * link-time coupling.)
+ */
+
+#include <errno.h>
+#include <stdlib.h>
+#include <string.h>
+#include <stdint.h>
+#include <unistd.h>
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+
+#include "aegis128l.c"
+#include "tb_client.h"
+
+#define HEADER_SIZE 256u
+#define MESSAGE_MAX (1u << 20)
+
+/* Header byte offsets (HEADER_DTYPE, vsr/header.py). */
+#define OFF_CHECKSUM 0
+#define OFF_CHECKSUM_BODY 16
+#define OFF_CLIENT 48
+#define OFF_CLUSTER 64
+#define OFF_SIZE 80
+#define OFF_VIEW 88
+#define OFF_OP 96
+#define OFF_COMMIT 104
+#define OFF_TIMESTAMP 112
+#define OFF_REQUEST 120
+#define OFF_REPLICA 124
+#define OFF_COMMAND 125
+#define OFF_OPERATION 126
+#define OFF_VERSION 127
+
+#define CMD_PING_CLIENT 3
+#define CMD_PONG_CLIENT 4
+#define CMD_REQUEST 5
+#define CMD_REPLY 8
+#define CMD_EVICTION 18
+
+#define OP_REGISTER 2
+#define OP_CREATE_ACCOUNTS 128
+#define OP_CREATE_TRANSFERS 129
+#define OP_LOOKUP_ACCOUNTS 130
+#define OP_LOOKUP_TRANSFERS 131
+
+struct tbc_client {
+    int fd;
+    uint64_t client_lo, client_hi;
+    uint64_t cluster;
+    uint32_t request;
+    uint32_t timeout_ms;
+};
+
+static void put64(uint8_t *p, uint64_t v) { memcpy(p, &v, 8); }
+static void put32(uint8_t *p, uint32_t v) { memcpy(p, &v, 4); }
+static uint64_t get64(const uint8_t *p) { uint64_t v; memcpy(&v, p, 8); return v; }
+static uint32_t get32(const uint8_t *p) { uint32_t v; memcpy(&v, p, 4); return v; }
+
+static void seal(uint8_t *hdr, const uint8_t *body, uint32_t body_len) {
+    uint8_t tag[16];
+    put32(hdr + OFF_SIZE, HEADER_SIZE + body_len);
+    aegis128l_mac(body, body_len, tag);
+    memcpy(hdr + OFF_CHECKSUM_BODY, tag, 16);
+    aegis128l_mac(hdr + 16, HEADER_SIZE - 16, tag);
+    memcpy(hdr + OFF_CHECKSUM, tag, 16);
+}
+
+static int frame_valid(const uint8_t *hdr, const uint8_t *body, uint32_t body_len) {
+    uint8_t tag[16];
+    aegis128l_mac(hdr + 16, HEADER_SIZE - 16, tag);
+    if (memcmp(tag, hdr + OFF_CHECKSUM, 16) != 0) return 0;
+    aegis128l_mac(body, body_len, tag);
+    return memcmp(tag, hdr + OFF_CHECKSUM_BODY, 16) == 0;
+}
+
+static int send_all(int fd, const uint8_t *p, size_t n) {
+    while (n) {
+        ssize_t w = send(fd, p, n, 0);
+        if (w <= 0) {
+            if (w < 0 && (errno == EINTR)) continue;
+            return -1;
+        }
+        p += w; n -= (size_t)w;
+    }
+    return 0;
+}
+
+static int recv_all(int fd, uint8_t *p, size_t n) {
+    while (n) {
+        ssize_t r = recv(fd, p, n, 0);
+        if (r <= 0) {
+            if (r < 0 && errno == EINTR) continue;
+            return (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK))
+                ? TBC_ERR_TIMEOUT : TBC_ERR_IO;
+        }
+        p += r; n -= (size_t)r;
+    }
+    return 0;
+}
+
+static void rand_bytes(uint8_t *p, size_t n) {
+    /* Client ids only need uniqueness, not cryptographic strength. */
+    static uint64_t seed = 0;
+    if (!seed) {
+        struct timeval tv;
+        gettimeofday(&tv, 0);
+        seed = (uint64_t)tv.tv_sec * 1000000u + (uint64_t)tv.tv_usec
+             ^ ((uint64_t)getpid() << 32);
+    }
+    for (size_t i = 0; i < n; i++) {
+        seed = seed * 6364136223846793005ull + 1442695040888963407ull;
+        p[i] = (uint8_t)(seed >> 33);
+    }
+}
+
+/* One request round trip; returns body length written to reply_body (>=0)
+ * or TBC_ERR_*. Replies for other commands (pongs) are skipped. */
+static int64_t roundtrip(
+    tbc_client *c, uint8_t operation,
+    const uint8_t *body, uint32_t body_len,
+    uint8_t *reply_body, uint32_t reply_max
+) {
+    if (HEADER_SIZE + body_len > MESSAGE_MAX) return TBC_ERR_TOO_LARGE;
+    uint8_t hdr[HEADER_SIZE];
+    memset(hdr, 0, sizeof(hdr));
+    c->request += 1;
+    put64(hdr + OFF_CLIENT, c->client_lo);
+    put64(hdr + OFF_CLIENT + 8, c->client_hi);
+    put64(hdr + OFF_CLUSTER, c->cluster);
+    put32(hdr + OFF_REQUEST, c->request);
+    hdr[OFF_COMMAND] = CMD_REQUEST;
+    hdr[OFF_OPERATION] = operation;
+    hdr[OFF_VERSION] = 1;
+    seal(hdr, body, body_len);
+    if (send_all(c->fd, hdr, HEADER_SIZE) != 0) return TBC_ERR_IO;
+    if (body_len && send_all(c->fd, body, body_len) != 0) return TBC_ERR_IO;
+
+    uint8_t rh[HEADER_SIZE];
+    uint8_t *rb = (uint8_t *)malloc(MESSAGE_MAX);
+    if (!rb) return TBC_ERR_ALLOC;
+    for (;;) {
+        int rc = recv_all(c->fd, rh, HEADER_SIZE);
+        if (rc != 0) { free(rb); return rc; }
+        uint32_t size = get32(rh + OFF_SIZE);
+        if (size < HEADER_SIZE || size > MESSAGE_MAX) {
+            free(rb); return TBC_ERR_PROTOCOL;
+        }
+        uint32_t blen = size - HEADER_SIZE;
+        rc = recv_all(c->fd, rb, blen);
+        if (rc != 0) { free(rb); return rc; }
+        if (!frame_valid(rh, rb, blen)) { free(rb); return TBC_ERR_PROTOCOL; }
+        uint8_t cmd = rh[OFF_COMMAND];
+        if (cmd == CMD_EVICTION) { free(rb); return TBC_ERR_EVICTED; }
+        if (cmd == CMD_REPLY
+            && get64(rh + OFF_CLIENT) == c->client_lo
+            && get64(rh + OFF_CLIENT + 8) == c->client_hi
+            && get32(rh + OFF_REQUEST) == c->request) {
+            if (blen > reply_max) { free(rb); return TBC_ERR_TOO_LARGE; }
+            if (blen) memcpy(reply_body, rb, blen);
+            free(rb);
+            return (int64_t)blen;
+        }
+        /* pong / stale frame: keep reading until our reply or timeout */
+    }
+}
+
+tbc_client *tbc_connect(
+    const char *host, uint16_t port, uint64_t cluster, uint32_t timeout_ms
+) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return 0;
+    struct sockaddr_in addr;
+    memset(&addr, 0, sizeof(addr));
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(port);
+    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1
+        || connect(fd, (struct sockaddr *)&addr, sizeof(addr)) != 0) {
+        close(fd);
+        return 0;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    struct timeval tv = {
+        .tv_sec = timeout_ms / 1000, .tv_usec = (timeout_ms % 1000) * 1000,
+    };
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+
+    tbc_client *c = (tbc_client *)calloc(1, sizeof(*c));
+    if (!c) { close(fd); return 0; }
+    c->fd = fd;
+    c->cluster = cluster;
+    c->timeout_ms = timeout_ms;
+    rand_bytes((uint8_t *)&c->client_lo, 8);
+    rand_bytes((uint8_t *)&c->client_hi, 8);
+    c->client_hi &= 0x7FFFFFFFFFFFFFFFull; /* < 2^127 like the Python client */
+    c->client_lo |= 1;                     /* never zero */
+
+    /* Hello: announce the client id so replies route to this socket. */
+    uint8_t hdr[HEADER_SIZE];
+    memset(hdr, 0, sizeof(hdr));
+    put64(hdr + OFF_CLIENT, c->client_lo);
+    put64(hdr + OFF_CLIENT + 8, c->client_hi);
+    put64(hdr + OFF_CLUSTER, c->cluster);
+    hdr[OFF_COMMAND] = CMD_PING_CLIENT;
+    hdr[OFF_VERSION] = 1;
+    seal(hdr, (const uint8_t *)"", 0);
+    if (send_all(fd, hdr, HEADER_SIZE) != 0) { tbc_close(c); return 0; }
+
+    /* Register the session. */
+    uint8_t none;
+    if (roundtrip(c, OP_REGISTER, (const uint8_t *)"", 0, &none, 0) < 0) {
+        tbc_close(c);
+        return 0;
+    }
+    return c;
+}
+
+void tbc_close(tbc_client *c) {
+    if (!c) return;
+    if (c->fd >= 0) close(c->fd);
+    free(c);
+}
+
+static int64_t batch_op(
+    tbc_client *c, uint8_t operation, uint32_t record_size,
+    const uint8_t *events, uint32_t count,
+    uint8_t *out, uint32_t out_max, uint32_t out_record_size
+) {
+    int64_t blen = roundtrip(
+        c, operation, events, count * record_size,
+        out, out_max * out_record_size
+    );
+    if (blen < 0) return blen;
+    if (blen % out_record_size != 0) return TBC_ERR_PROTOCOL;
+    return blen / out_record_size;
+}
+
+int64_t tbc_create_accounts(
+    tbc_client *c, const uint8_t *events, uint32_t count,
+    uint8_t *results_out, uint32_t results_max
+) {
+    return batch_op(c, OP_CREATE_ACCOUNTS, 128, events, count,
+                    results_out, results_max, 8);
+}
+
+int64_t tbc_create_transfers(
+    tbc_client *c, const uint8_t *events, uint32_t count,
+    uint8_t *results_out, uint32_t results_max
+) {
+    return batch_op(c, OP_CREATE_TRANSFERS, 128, events, count,
+                    results_out, results_max, 8);
+}
+
+int64_t tbc_lookup_accounts(
+    tbc_client *c, const uint8_t *ids, uint32_t count,
+    uint8_t *accounts_out, uint32_t accounts_max
+) {
+    return batch_op(c, OP_LOOKUP_ACCOUNTS, 16, ids, count,
+                    accounts_out, accounts_max, 128);
+}
+
+int64_t tbc_lookup_transfers(
+    tbc_client *c, const uint8_t *ids, uint32_t count,
+    uint8_t *transfers_out, uint32_t transfers_max
+) {
+    return batch_op(c, OP_LOOKUP_TRANSFERS, 16, ids, count,
+                    transfers_out, transfers_max, 128);
+}

@@ -1,0 +1,74 @@
+/* tb_client.h — C ABI client for tigerbeetle-tpu.
+ *
+ * The role of the reference's src/clients/c/tb_client.zig + generated
+ * header: a native client any C-ABI language (Go/cgo, Java/JNI, .NET
+ * P/Invoke, Node N-API) can bind. Blocking-socket implementation with one
+ * VSR session per handle; messages are 256-byte AEGIS-128L-sealed headers
+ * + <= 1 MiB bodies, byte-identical to the Python client's wire format.
+ *
+ * Records are the wire-exact 128-byte Account/Transfer structs
+ * (tigerbeetle_tpu/types.py, reference src/tigerbeetle.zig): pack them in
+ * the caller's language and pass raw buffers.
+ *
+ * All functions return >= 0 on success (result counts where applicable)
+ * and a negative TBC_ERR_* on failure. Requires an AES-NI x86-64 host
+ * (the cluster's AEGIS-128L checksum); link with tb_client.c compiled
+ * with -maes -mssse3.
+ */
+
+#ifndef TB_CLIENT_H
+#define TB_CLIENT_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct tbc_client tbc_client;
+
+enum {
+    TBC_OK = 0,
+    TBC_ERR_CONNECT = -1,   /* TCP connect/handshake failed            */
+    TBC_ERR_IO = -2,        /* send/recv failed mid-request            */
+    TBC_ERR_TIMEOUT = -3,   /* no reply within the timeout             */
+    TBC_ERR_PROTOCOL = -4,  /* bad/unauthenticated reply frame         */
+    TBC_ERR_EVICTED = -5,   /* session evicted by the cluster          */
+    TBC_ERR_TOO_LARGE = -6, /* batch exceeds the 1 MiB message budget  */
+    TBC_ERR_ALLOC = -7,
+};
+
+/* Connect to one replica and register a session. cluster is the cluster
+ * id's low 64 bits (the Python tooling formats clusters with ids < 2^64).
+ * timeout_ms bounds each request round trip. Returns NULL on failure. */
+tbc_client *tbc_connect(
+    const char *host, uint16_t port, uint64_t cluster, uint32_t timeout_ms);
+
+void tbc_close(tbc_client *c);
+
+/* Batched operations. events/ids are packed wire records; results_out
+ * receives (index u32, result u32) pairs for create_* (failures only,
+ * per the protocol) or whole records for lookups. *_max is the capacity
+ * of the out buffer in RECORDS. Returns the number of records written,
+ * or TBC_ERR_*. */
+int64_t tbc_create_accounts(
+    tbc_client *c, const uint8_t *events, uint32_t count,
+    uint8_t *results_out, uint32_t results_max);
+
+int64_t tbc_create_transfers(
+    tbc_client *c, const uint8_t *events, uint32_t count,
+    uint8_t *results_out, uint32_t results_max);
+
+int64_t tbc_lookup_accounts(
+    tbc_client *c, const uint8_t *ids /* 16 B each */, uint32_t count,
+    uint8_t *accounts_out, uint32_t accounts_max);
+
+int64_t tbc_lookup_transfers(
+    tbc_client *c, const uint8_t *ids, uint32_t count,
+    uint8_t *transfers_out, uint32_t transfers_max);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TB_CLIENT_H */

@@ -203,6 +203,49 @@ def aegis128l_mac() -> Optional[Callable[[bytes], bytes]]:
     return _mac
 
 
+_tbclient: Optional[ctypes.CDLL] = None
+_tbclient_tried = False
+
+
+def tb_client() -> Optional[ctypes.CDLL]:
+    """The C ABI client library (csrc/tb_client.c + tb_client.h — the
+    reference's clients/c/tb_client.zig role): built on demand, loaded via
+    ctypes for the test harness; external embedders link it directly.
+    Requires AES-NI (the cluster checksum)."""
+    global _tbclient, _tbclient_tried
+    if _tbclient_tried:
+        return _tbclient
+    _tbclient_tried = True
+    if not _cpu_has_aes():
+        return None
+    src = os.path.join(_CSRC, "tb_client.c")
+    lib_path = os.path.join(_CSRC, "libtbclient.so")
+    if not os.path.exists(src) or not _build_lib(
+        src, lib_path, extra_flags=("-maes", "-mssse3")
+    ):
+        return None
+    try:
+        lib = ctypes.CDLL(lib_path)
+    except OSError:
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.tbc_connect.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint16, ctypes.c_uint64, ctypes.c_uint32,
+    ]
+    lib.tbc_connect.restype = ctypes.c_void_p
+    lib.tbc_close.argtypes = [ctypes.c_void_p]
+    for fn in (
+        lib.tbc_create_accounts, lib.tbc_create_transfers,
+        lib.tbc_lookup_accounts, lib.tbc_lookup_transfers,
+    ):
+        fn.argtypes = [
+            ctypes.c_void_p, u8p, ctypes.c_uint32, u8p, ctypes.c_uint32,
+        ]
+        fn.restype = ctypes.c_int64
+    _tbclient = lib
+    return _tbclient
+
+
 def aegis128l_mac_ptr() -> Optional[Callable[[int, int], bytes]]:
     """(address, nbytes) -> 16-byte tag over raw memory — the zero-copy
     sibling of aegis128l_mac for numpy-array bodies."""
