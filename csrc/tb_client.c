@@ -24,6 +24,10 @@
 #include <netinet/tcp.h>
 #include <sys/socket.h>
 #include <sys/time.h>
+#include <fcntl.h>
+#if defined(__linux__)
+#include <sys/random.h>
+#endif
 
 #include "aegis128l.c"
 #include "tb_client.h"
@@ -115,13 +119,44 @@ static int recv_all(int fd, uint8_t *p, size_t n) {
 }
 
 static void rand_bytes(uint8_t *p, size_t n) {
-    /* Client ids only need uniqueness, not cryptographic strength. */
-    static uint64_t seed = 0;
+    /* Client ids must be unique across threads AND processes: two handles
+     * sharing an id share one VSR session (crossed replies). Use the OS
+     * entropy pool — a static LCG seed is a data race under concurrent
+     * tbc_connect calls and collides on same-microsecond connects. */
+#if defined(__linux__)
+    size_t off = 0;
+    while (off < n) {
+        ssize_t r = getrandom(p + off, n - off, 0);
+        if (r < 0) {
+            if (errno == EINTR) continue;
+            break;
+        }
+        off += (size_t)r;
+    }
+    if (off == n) return;
+#endif
+    int fd = open("/dev/urandom", O_RDONLY);
+    if (fd >= 0) {
+        size_t got = 0;
+        while (got < n) {
+            ssize_t r = read(fd, p + got, n - got);
+            if (r <= 0) {
+                if (r < 0 && errno == EINTR) continue;
+                break;
+            }
+            got += (size_t)r;
+        }
+        close(fd);
+        if (got == n) return;
+    }
+    /* Last resort (no /dev/urandom): thread-local LCG mixed with the
+     * output address so concurrent callers diverge. */
+    static _Thread_local uint64_t seed = 0;
     if (!seed) {
         struct timeval tv;
         gettimeofday(&tv, 0);
-        seed = (uint64_t)tv.tv_sec * 1000000u + (uint64_t)tv.tv_usec
-             ^ ((uint64_t)getpid() << 32);
+        seed = ((uint64_t)tv.tv_sec * 1000000u + (uint64_t)tv.tv_usec)
+             ^ ((uint64_t)getpid() << 32) ^ (uint64_t)(uintptr_t)p;
     }
     for (size_t i = 0; i < n; i++) {
         seed = seed * 6364136223846793005ull + 1442695040888963407ull;

@@ -436,7 +436,105 @@ class TestStandby:
             zombie._execute(m, replay=True)
             zombie.commit_min = op
         assert zombie.retired
+        # The deterministic epoch bump was rebuilt by the replay.
+        assert zombie.config_epoch == 1
         # And the promoted replica re-executing its own promotion op on
         # replay must NOT retire (promoted_at_op guard).
         assert promoted.superblock.state.promoted_at_op == reconf_op
         assert not promoted.retired
+
+    def test_stale_epoch_votes_are_fenced(self):
+        """A stale slot occupant (config_epoch behind: it has not committed
+        the RECONFIGURE that reassigned its slot) must carry no quorum
+        weight — its PREPARE_OK / SVC / DVC are dropped, so a prepare
+        quorum counting the old node can never be followed by a
+        view-change quorum seeing only the new one (advisor r4)."""
+        from tigerbeetle_tpu.vsr import header as hdr
+        from tigerbeetle_tpu.vsr.header import Command, Message
+
+        cl, c = self._loaded(seed=94)
+        target = max(r.commit_min for r in cl.replicas[:3])
+        cl.run_until(lambda: cl.replicas[3].commit_min >= target, 40_000)
+        victim = next(
+            r.replica for r in cl.replicas[:3]
+            if r is not None and not r.is_primary
+        )
+        cl.crash_replica(victim)
+        cl.reconfigure_promote(3, victim)
+        cl.run_until(
+            lambda: cl.replicas[victim] is not None
+            and not cl.replicas[victim].is_standby,
+            60_000,
+        )
+        live = [r for r in cl.replicas[:3] if r is not None]
+        assert all(r.config_epoch == 1 for r in live)
+        primary = next(r for r in live if r.is_primary)
+
+        # Stale-epoch PREPARE_OK carries no quorum weight. Inject it
+        # synchronously into an in-flight prepare (net delivery paused so
+        # the pipeline entry is observable).
+        c.request(Operation.CREATE_TRANSFERS, transfer_batch([
+            dict(id=900, debit_account_id=1, credit_account_id=2,
+                 amount=1, ledger=1, code=1),
+        ]))
+        cl.run_until(
+            lambda: len(primary.pipeline) > 0 or c.idle, 20_000
+        )
+        if primary.pipeline:
+            entry = primary.pipeline[0]
+            before = set(entry.ok_from)
+            ok_stale = hdr.make(
+                Command.PREPARE_OK, cl.cluster_id,
+                view=primary.view, op=entry.message.header["op"],
+                parent=entry.message.header["checksum"], replica=victim,
+                timestamp=entry.message.header["timestamp"], epoch=0,
+            )
+            primary.on_message(Message(ok_stale).seal())
+            assert set(entry.ok_from) == before
+        cl.run_until(lambda: c.idle, 40_000)
+
+        # Stale-epoch SVC vote (the zombie old occupant's epoch is 0).
+        v = primary.view + 1
+        svc_stale = hdr.make(
+            Command.START_VIEW_CHANGE, cl.cluster_id,
+            view=v, replica=victim, epoch=0,
+        )
+        primary.on_message(Message(svc_stale).seal())
+        assert victim not in primary.start_view_change_from.get(v, set())
+        # Stale-epoch DVC is equally ignored (a future view with the same
+        # primary — view 1's dict still holds the REAL election's votes).
+        v2 = primary.view + 3
+        assert primary.primary_index(v2) == primary.replica
+        dvc_stale = hdr.make(
+            Command.DO_VIEW_CHANGE, cl.cluster_id,
+            view=v2, replica=victim, op=primary.op,
+            commit=primary.commit_min, timestamp=primary.log_view, epoch=0,
+        )
+        status_before = primary.status
+        primary.on_message(Message(dvc_stale).seal())
+        assert victim not in primary.do_view_change_from.get(v2, {})
+        assert primary.status == status_before  # probe must not disturb it
+        # A current-epoch vote from the same index DOES register: the
+        # fence keys on epoch, not identity. (One vote per view below —
+        # two in one view would form an SVC quorum and stall the test.)
+        svc_ok = hdr.make(
+            Command.START_VIEW_CHANGE, cl.cluster_id,
+            view=v, replica=victim, epoch=1,
+        )
+        primary.on_message(Message(svc_ok).seal())
+        assert victim in primary.start_view_change_from.get(v, set())
+        # A LAGGING member of a never-reassigned slot (epoch still 0: it
+        # has not committed the RECONFIGURE) keeps full vote weight — a
+        # global epoch fence would starve view changes whenever a
+        # surviving member missed the RECONFIGURE commit.
+        lagger = next(
+            r.replica for r in live
+            if not r.is_primary and r.replica != victim
+        )
+        v3 = primary.view + 2
+        svc_lag = hdr.make(
+            Command.START_VIEW_CHANGE, cl.cluster_id,
+            view=v3, replica=lagger, epoch=0,
+        )
+        primary.on_message(Message(svc_lag).seal())
+        assert lagger in primary.start_view_change_from.get(v3, set())

@@ -274,17 +274,41 @@ class Workload:
         # without standbys can never commit one — skip the per-tick probe.)
         if getattr(self.cluster, "standby_count", 0):
             nxt = self.auditor._applied_op + 1
-            for r in self.cluster.replicas:
-                if r is None or r.commit_min < nxt:
-                    continue
+            eligible = [
+                r for r in self.cluster.replicas
+                if r is not None and r.commit_min >= nxt
+            ]
+            for r in eligible:
                 m = r.journal.read_prepare(nxt)
+                if m is None:
+                    # This replica's WAL ring already wrapped past op nxt —
+                    # keep scanning the others rather than wedging the
+                    # drain on the first inspectable replica.
+                    continue
                 if (
-                    m is not None
-                    and m.header["client"] == 0
+                    m.header["client"] == 0
                     and m.header["operation"] == Operation.RECONFIGURE
                 ):
                     self.auditor.note_control_op(nxt)
                 break
+            else:
+                # Every live replica's ring wrapped past op nxt AND the op
+                # is below every checkpoint: its prepare is unrecoverable,
+                # so if it was a control op the probe can never see it.
+                # Guard on no in-flight requests: a client op's reply may
+                # merely be delayed, and implicitly acking it would desync
+                # the oracle forever (the late reply is then dropped as a
+                # duplicate). With nothing in flight, a drain stuck here
+                # can only be a control op — pass it (harness liveness).
+                if (
+                    eligible
+                    and not self._inflight
+                    and all(
+                        nxt <= r.superblock.state.op_checkpoint
+                        for r in eligible
+                    )
+                ):
+                    self.auditor.note_control_op(nxt)
         for client in self.cluster.clients.values():
             if not client.registered or not client.idle:
                 continue

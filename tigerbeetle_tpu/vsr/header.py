@@ -148,9 +148,16 @@ def _select_checksum():
             mac_ptr = native.aegis128l_mac_ptr()
 
             def _cs(data):
-                if mac_ptr is not None and isinstance(data, np.ndarray):
+                if (
+                    mac_ptr is not None
+                    and isinstance(data, np.ndarray)
+                    and data.flags["C_CONTIGUOUS"]
+                ):
                     # MAC straight over the array memory — bytes(arr) would
-                    # copy ~1 MiB per client batch for nothing.
+                    # copy ~1 MiB per client batch for nothing. Strided or
+                    # sliced views MUST take the copying path: ctypes.data
+                    # walks raw memory, so a non-contiguous array would MAC
+                    # the wrong bytes (silently-dropped messages downstream).
                     return int.from_bytes(
                         mac_ptr(data.ctypes.data, data.nbytes), "little"
                     )

@@ -129,6 +129,26 @@ class Replica:
         # (standby, target) pairs whose RECONFIGURE this replica has
         # committed — primary-side dedupe of duplicate operator requests.
         self.reconfigures_applied: set = set()
+        # Configuration epoch = count of committed RECONFIGUREs (reference
+        # epoch semantics), carried on quorum-vote messages (PREPARE_OK /
+        # SVC / DVC). The fence is PER SLOT: slot_epoch[i] is the epoch at
+        # which slot i was last reassigned, and a vote from slot i below
+        # that epoch is dropped — it can only come from the STALE occupant
+        # (the promoted occupant committed the reassigning RECONFIGURE, so
+        # its votes carry at least that epoch). A merely-lagging member of
+        # a never-reassigned slot keeps full quorum weight, so the fence
+        # can never starve a legitimate view change (a global `epoch <
+        # ours` drop would: a member that missed the RECONFIGURE commit
+        # could neither vote nor, primary-less, ever catch up).
+        # Residual window, as in the reference's epoch design: a receiver
+        # that has NOT yet committed the RECONFIGURE has no slot_epoch
+        # entry and still accepts the stale occupant's votes until its own
+        # commit catches up.
+        # Both values are rebuilt deterministically by WAL replay and are
+        # persisted ONLY at checkpoint boundaries / in the snapshot blob
+        # (mid-commit persistence would double-count on replay).
+        self.config_epoch = 0
+        self.slot_epoch: Dict[int, int] = {}
         # Eviction decisions are deferred while ops at or below this floor
         # (the suffix inherited at election) are uncommitted — set when
         # becoming primary of a new view / opening.
@@ -286,6 +306,8 @@ class Replica:
         self.commit_min = st.op_checkpoint
         self.commit_max = max(st.commit_max, st.op_checkpoint)
         self.checksum_floor = st.op_checkpoint
+        self.config_epoch = st.config_epoch
+        self.slot_epoch = {}  # rebuilt by snapshot install + WAL replay
 
         resume_block_sync: Optional[Dict[int, int]] = None
         if st.op_checkpoint > 0:
@@ -815,12 +837,15 @@ class Replica:
             view=self.view, op=prepare_header["op"],
             parent=prepare_header["checksum"],
             replica=self.replica, timestamp=prepare_header["timestamp"],
+            epoch=self.config_epoch,
         )
         self.bus.send_to_replica(self.primary_index(self.view), Message(ok).seal())
 
     def on_prepare_ok(self, msg: Message) -> None:
         if not self.is_primary or msg.header["view"] != self.view:
             return
+        if msg.header["epoch"] < self.slot_epoch.get(int(msg.header["replica"]), 0):
+            return  # stale occupant of a reassigned slot: no quorum weight
         op = msg.header["op"]
         for entry in self.pipeline:
             if entry.message.header["op"] == op:
@@ -1469,7 +1494,7 @@ class Replica:
             return
         svc = hdr.make(
             Command.START_VIEW_CHANGE, self.cluster,
-            view=new_view, replica=self.replica,
+            view=new_view, replica=self.replica, epoch=self.config_epoch,
         )
         m = Message(svc).seal()
         for r in range(self.replica_count):
@@ -1510,7 +1535,7 @@ class Replica:
         self._persist_view()
         svc = hdr.make(
             Command.START_VIEW_CHANGE, self.cluster,
-            view=new_view, replica=self.replica,
+            view=new_view, replica=self.replica, epoch=self.config_epoch,
         )
         m = Message(svc).seal()
         for r in range(self.replica_count):
@@ -1523,6 +1548,8 @@ class Replica:
         v = msg.header["view"]
         if v < self.view:
             return
+        if msg.header["epoch"] < self.slot_epoch.get(int(msg.header["replica"]), 0):
+            return  # stale occupant of a reassigned slot: no view-change vote
         self.start_view_change_from.setdefault(v, set()).add(msg.header["replica"])
         self._maybe_enter_view_change(v)
 
@@ -1543,6 +1570,7 @@ class Replica:
             Command.DO_VIEW_CHANGE, self.cluster,
             view=v, replica=self.replica, op=self.op,
             commit=self.commit_min, timestamp=self.log_view,
+            epoch=self.config_epoch,
         )
         body = b"".join(h.to_bytes() for h in headers)
         m = Message(dvc, body).seal()
@@ -1581,6 +1609,8 @@ class Replica:
         v = msg.header["view"]
         if v < self.view or self.primary_index(v) != self.replica:
             return
+        if msg.header["epoch"] < self.slot_epoch.get(int(msg.header["replica"]), 0):
+            return  # stale occupant: its log must not win an election
         if v > self.view:
             self._start_view_change(v)
         self.do_view_change_from.setdefault(v, {})[msg.header["replica"]] = msg
@@ -1857,6 +1887,12 @@ class Replica:
                 ):
                     tracer.count("mark.reconfigure_commit")
                     self.reconfigures_applied.add((standby_ix, target_ix))
+                    # Epoch bump + per-slot reassignment record are
+                    # deterministic (functions of the committed op stream)
+                    # so WAL replay rebuilds them; durable only via
+                    # checkpoints / the snapshot blob.
+                    self.config_epoch += 1
+                    self.slot_epoch[target_ix] = self.config_epoch
                     if self.is_standby and self.replica == standby_ix:
                         # THIS standby takes over the vacated active slot:
                         # adopt the identity durably (the superblock is the
@@ -2001,6 +2037,7 @@ class Replica:
         st.log_view = self.log_view
         st.prepare_timestamp = self.committed_timestamp_max
         st.commit_timestamp = self.state_machine.commit_timestamp
+        st.config_epoch = self.config_epoch
         st.trailer_block = trailer_block
         self.superblock.checkpoint()
         # The checkpoint is durable: staged grid frees (tables replaced by

@@ -41,6 +41,10 @@ CLIENT_ENTRY_DTYPE = np.dtype(
     ]
 )
 
+# (slot, epoch at which it was last reassigned by a committed
+# RECONFIGURE) — the per-slot quorum fence (replica.slot_epoch).
+SLOT_EPOCH_DTYPE = np.dtype([("slot", "<u4"), ("_pad", "<u4"), ("epoch", "<u8")])
+
 # (index, payload checksum) of every content block the checkpoint
 # references — the identity list block-level state sync verifies against
 # (reference: block references carry checksums; grid_blocks_missing.zig).
@@ -132,6 +136,14 @@ def referenced_blocks(sm, tree_fences) -> np.ndarray:
     return free
 
 
+def _slot_epochs_array(replica) -> np.ndarray:
+    rows = np.zeros(len(replica.slot_epoch), dtype=SLOT_EPOCH_DTYPE)
+    for i, (slot, epoch) in enumerate(sorted(replica.slot_epoch.items())):
+        rows[i]["slot"] = slot
+        rows[i]["epoch"] = epoch
+    return rows
+
+
 def encode(replica) -> bytes:
     """Serialize the replica's replicated state at its current commit
     point. Transfers stay in the grid; the blob carries the account
@@ -170,6 +182,12 @@ def encode(replica) -> bytes:
         bal_dp=dp, bal_dpo=dpo, bal_cp=cp, bal_cpo=cpo,
         prepare_timestamp=np.uint64(replica.committed_timestamp_max),
         commit_timestamp=np.uint64(sm.commit_timestamp),
+        # Count of committed RECONFIGUREs at this checkpoint + per-slot
+        # reassignment epochs: state sync must install them (a synced
+        # replica never replays the ops that bumped them). Deterministic
+        # across replicas, so the storage checker's byte-comparison holds.
+        config_epoch=np.uint64(replica.config_epoch),
+        slot_epochs=_slot_epochs_array(replica),
         client_table=client_rows,
         client_replies=np.frombuffer(b"".join(reply_blobs), dtype=np.uint8),
     )
@@ -241,8 +259,8 @@ _LOCAL_REQUIRED = (
     "acc_ud128_lo", "acc_ud128_hi", "acc_ud64", "acc_ud32",
     "acc_ledger", "acc_code", "acc_flags", "acc_ts",
     "bal_dp", "bal_dpo", "bal_cp", "bal_cpo",
-    "prepare_timestamp", "commit_timestamp", "client_table",
-    "client_replies",
+    "prepare_timestamp", "commit_timestamp", "config_epoch",
+    "slot_epochs", "client_table", "client_replies",
     *(f"{p}_{s}" for p in _TREE_PREFIXES
       for s in ("manifest", "fences", "fence_counts")),
     *(f"{p}_{s}" for p in _LOG_PREFIXES for s in ("blocks", "tail")),
@@ -361,6 +379,11 @@ def install(replica, blob: bytes, rebuild_bloom: bool = True,
     sm.prepare_timestamp = int(z["prepare_timestamp"])
     replica.committed_timestamp_max = int(z["prepare_timestamp"])
     sm.commit_timestamp = int(z["commit_timestamp"])
+    replica.config_epoch = int(z["config_epoch"])
+    replica.superblock.state.config_epoch = replica.config_epoch
+    replica.slot_epoch = {
+        int(r["slot"]): int(r["epoch"]) for r in z["slot_epochs"]
+    }
 
     replies = z["client_replies"].tobytes()
     offset = 0

@@ -54,7 +54,12 @@ SUPERBLOCK_DTYPE = np.dtype(
         # standby (0 = never promoted): replaying that op must not make
         # the promoted replica retire itself from its own slot.
         ("promoted_at_op", "<u8"),
-        ("reserved", "V368"),
+        # Configuration epoch: count of committed RECONFIGURE ops. Carried
+        # in quorum-vote message headers to fence a stale slot occupant out
+        # of prepare/view-change quorums after its slot was reassigned
+        # (reference epoch semantics, vsr.zig Membership; advisor r4).
+        ("config_epoch", "<u8"),
+        ("reserved", "V360"),
     ]
 )
 assert SUPERBLOCK_DTYPE.itemsize == 512
@@ -80,6 +85,7 @@ class VSRState:
     trailer_block: int = 0xFFFFFFFF  # NO_TRAILER
     sync_pending: int = 0
     promoted_at_op: int = 0
+    config_epoch: int = 0
     sequence: int = field(default=0)
 
 
@@ -112,6 +118,7 @@ class SuperBlock:
         rec["trailer_block"] = s.trailer_block
         rec["sync_pending"] = s.sync_pending
         rec["promoted_at_op"] = s.promoted_at_op
+        rec["config_epoch"] = s.config_epoch
         c = checksum(rec.tobytes()[16:])
         rec["checksum_lo"] = c & ((1 << 64) - 1)
         rec["checksum_hi"] = c >> 64
@@ -141,6 +148,7 @@ class SuperBlock:
             trailer_block=int(rec["trailer_block"]),
             sync_pending=int(rec["sync_pending"]),
             promoted_at_op=int(rec["promoted_at_op"]),
+            config_epoch=int(rec["config_epoch"]),
             sequence=int(rec["sequence"]),
         )
 
