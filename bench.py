@@ -532,38 +532,64 @@ def bench_config5_lsm():
 def bench_e2e():
     """End-to-end: client → TCP → VSR → WAL → state machine, single replica
     on this host (numpy backend: the device sits behind a high-latency
-    tunnel in this environment; a production replica is chip-colocated)."""
+    tunnel in this environment; a production replica is chip-colocated).
+
+    Three full runs; the headline is the MEDIAN by accepted tx/s with the
+    min-max spread recorded — single-run numbers on this one-core host
+    swing with scheduler luck (r4's official 394k re-ran at 649k)."""
     import re
     import subprocess
 
     env = dict(os.environ)
-    port = 3900 + os.getpid() % 900  # avoid stale-listener collisions
-    proc = subprocess.run(
-        [
-            sys.executable, "-m", "tigerbeetle_tpu.cli", "benchmark",
-            "--accounts=10000", f"--transfers={E2E_TRANSFERS}",
-            "--backend=numpy", f"--port={port}", "--queries=100",
-        ],
-        capture_output=True, text=True, timeout=900, env=env,
-        cwd=os.path.dirname(os.path.abspath(__file__)),
-    )
-    out = {}
-    for line in proc.stdout.splitlines():
-        m = re.match(r"load accepted = ([\d,]+) tx/s", line)
-        if m:
-            out["load_accepted_tx_per_s"] = float(m.group(1).replace(",", ""))
-        m = re.match(r"batch latency p50 = ([\d.]+) ms", line)
-        if m:
-            out["batch_p50_ms"] = float(m.group(1))
-        m = re.match(r"batch latency p90 = ([\d.]+) ms", line)
-        if m:
-            out["batch_p90_ms"] = float(m.group(1))
-        m = re.match(r"query latency p90 = ([\d.]+) ms", line)
-        if m:
-            out["query_p90_ms"] = float(m.group(1))
-    if not out:
-        out["error"] = (proc.stdout + proc.stderr)[-400:]
-    return out
+
+    def one_run(port: int):
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "tigerbeetle_tpu.cli", "benchmark",
+                "--accounts=10000", f"--transfers={E2E_TRANSFERS}",
+                "--backend=numpy", f"--port={port}", "--queries=100",
+                "--clients=2",
+            ],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        out = {}
+        pats = {
+            "load_accepted_tx_per_s": r"load accepted = ([\d,]+) tx/s",
+            "batch_p50_ms": r"batch latency p50 = ([\d.]+) ms",
+            "batch_p90_ms": r"batch latency p90 = ([\d.]+) ms",
+            "perceived_p50_ms": r"client-perceived p50 = ([\d.]+) ms",
+            "perceived_p90_ms": r"client-perceived p90 = ([\d.]+) ms",
+            "query_p90_ms": r"query latency p90 = ([\d.]+) ms",
+        }
+        for line in proc.stdout.splitlines():
+            for key, pat in pats.items():
+                m = re.match(pat, line)
+                if m:
+                    out[key] = float(m.group(1).replace(",", ""))
+        if "load_accepted_tx_per_s" not in out:
+            out["error"] = (proc.stdout + proc.stderr)[-400:]
+        return out
+
+    runs = []
+    base_port = 3900 + os.getpid() % 800
+    for i in range(3):
+        if i:
+            # Quiesce the previous run's page-cache writeback so run i
+            # does not pay run i-1's dirty pages (one disk, one core).
+            os.sync()
+            time.sleep(2)
+        r = one_run(base_port + i)
+        if "error" in r:
+            return r
+        runs.append(r)
+    runs.sort(key=lambda r: r["load_accepted_tx_per_s"])
+    med = dict(runs[1])  # median by accepted throughput
+    lo = runs[0]["load_accepted_tx_per_s"]
+    hi = runs[2]["load_accepted_tx_per_s"]
+    med["runs_tx_per_s"] = [r["load_accepted_tx_per_s"] for r in runs]
+    med["spread_pct"] = round(100.0 * (hi - lo) / max(hi, 1.0), 1)
+    return med
 
 
 def main() -> None:

@@ -183,6 +183,13 @@ class Grid:
         head["checksum_lo"] = c & ((1 << 64) - 1)
         head["checksum_hi"] = c >> 64
         self.storage.write(self._addr(index), head.tobytes() + payload)
+        # Start async writeback NOW: with the WAL on direct IO the data
+        # file is no longer fdatasync'd per prepare, so without pacing
+        # dirty grid pages would pile up until the next checkpoint's sync
+        # and stall it (no durability implied — checkpoint still syncs).
+        kick = getattr(self.storage, "writeback_kick", None)
+        if kick is not None:
+            kick(self._addr(index), self.block_size)
         self.writes += 1
         self.block_cks[index] = c
         self._cache_put(index, bytes(payload))

@@ -1,22 +1,64 @@
 """Block storage: sector-addressed durable byte ranges.
 
-The production backend is a file (buffered writes + fsync on `sync()`; the
-reference's O_DIRECT discipline, src/storage.zig:14, is a later native-shim
-concern). The test backend is in-memory with per-sector fault injection,
-mirroring src/testing/storage.zig:57 — reads of faulty sectors return
-corrupted bytes so recovery paths are exercised, and `crash()` drops writes
-that were not yet synced (torn-write model).
+The production backend is a file with TWO write disciplines, mirroring the
+reference's O_DIRECT sector IO (src/storage.zig:14):
+
+  - `write` + `sync`: buffered pwrite, fdatasync barrier (superblock,
+    header ring, small metadata).
+  - `write_durable`: sector-aligned O_DIRECT|O_DSYNC pwrite — durable at
+    syscall return, bypassing the page cache entirely. This is the WAL
+    prepare-body path: a whole-file fdatasync flushes EVERY dirty page
+    (grid blocks included) and concurrent pwrites stall behind it, which
+    measured 3-4x slower under sustained load than direct DMA.
+  - `writeback_kick`: non-blocking sync_file_range(WRITE) so buffered grid
+    writes stream to disk continuously instead of piling up for the next
+    checkpoint's fdatasync.
+
+The test backend is in-memory with per-sector fault injection, mirroring
+src/testing/storage.zig:57 — reads of faulty sectors return corrupted
+bytes so recovery paths are exercised, and `crash()` drops writes that
+were not yet synced (torn-write model).
 
 The on-disk layout zones mirror src/vsr.zig:67-109.
 """
 
 from __future__ import annotations
 
+import mmap
 import os
+import threading
 from dataclasses import dataclass
+from typing import Sequence
 
 from tigerbeetle_tpu.constants import SECTOR_SIZE
 from tigerbeetle_tpu.vsr.header import HEADER_SIZE
+
+# sync_file_range(2) via libc (not in the os module). Async writeback
+# start only — NOT a durability barrier (no disk-cache flush): used purely
+# to smooth dirty-page accumulation between checkpoints.
+_SYNC_FILE_RANGE_WRITE = 2
+_libc = None
+_libc_tried = False
+
+
+def _sync_file_range(fd: int, offset: int, nbytes: int) -> None:
+    global _libc, _libc_tried
+    if not _libc_tried:
+        _libc_tried = True
+        try:
+            import ctypes
+
+            _libc = ctypes.CDLL(None, use_errno=True)
+            _libc.sync_file_range.argtypes = [
+                ctypes.c_int, ctypes.c_int64, ctypes.c_int64, ctypes.c_uint,
+            ]
+        except (OSError, AttributeError):
+            _libc = None
+    if _libc is not None:
+        try:
+            _libc.sync_file_range(fd, offset, nbytes, _SYNC_FILE_RANGE_WRITE)
+        except OSError:
+            pass
 
 
 @dataclass(frozen=True)
@@ -124,6 +166,20 @@ class MemStorage:
         self.writes += 1
         self._unsynced[offset] = bytes(data)
 
+    def write_durable(self, offset: int, chunks: Sequence[bytes]) -> None:
+        """Durable-at-return write (the O_DIRECT|O_DSYNC model): lands in
+        the synced image immediately, never pending in the crash model."""
+        data = b"".join(chunks)
+        assert offset + len(data) <= self.size
+        self.writes += 1
+        self._data[offset : offset + len(data)] = data
+        # An older buffered write at the same offset must not shadow the
+        # durable bytes through the read overlay.
+        self._unsynced.pop(offset, None)
+
+    def writeback_kick(self, offset: int, nbytes: int) -> None:
+        pass  # page-cache writeback pacing: meaningless in memory
+
     def sync(self) -> None:
         for woff, wdata in self._unsynced.items():
             self._data[woff : woff + len(wdata)] = wdata
@@ -153,7 +209,11 @@ class MemStorage:
 
 
 class FileStorage:
-    """File-backed storage (buffered + fsync)."""
+    """File-backed storage: buffered writes + fdatasync, plus an O_DIRECT
+    second fd for sector-aligned durable-at-return writes (the WAL body
+    path — see module docstring)."""
+
+    DIRECT_ALIGN = 4096  # ≥ any real logical block size; = SECTOR_SIZE
 
     def __init__(self, path: str, size: int | None = None, create: bool = False) -> None:
         self.path = path
@@ -162,12 +222,71 @@ class FileStorage:
         if create and size is not None:
             os.ftruncate(self._fd, size)
         self.size = os.fstat(self._fd).st_size
+        # O_DIRECT|O_DSYNC fd: durable DMA writes that never touch the page
+        # cache. Unavailable on some filesystems (tmpfs) — fall back to
+        # buffered+fdatasync in write_durable.
+        self._dfd: int | None = None
+        self._dbuf: mmap.mmap | None = None  # page-aligned bounce buffer
+        self._dlock = threading.Lock()
+        try:
+            self._dfd = os.open(
+                path, os.O_RDWR | os.O_DIRECT | os.O_DSYNC
+            )
+        except (OSError, AttributeError):
+            self._dfd = None
+
+    @property
+    def supports_direct(self) -> bool:
+        return self._dfd is not None
 
     def read(self, offset: int, size: int) -> bytes:
         return os.pread(self._fd, size, offset)
 
     def write(self, offset: int, data: bytes) -> None:
         os.pwrite(self._fd, data, offset)
+
+    def write_durable(self, offset: int, chunks: Sequence[bytes]) -> None:
+        """Write `chunks` contiguously at `offset`, durable at return.
+
+        Direct path: copy into the page-aligned bounce buffer, pad the
+        tail to the alignment unit (slack inside the owning slot — callers
+        guarantee the padded length fits), one O_DIRECT|O_DSYNC pwrite.
+        Fallback: buffered pwrite + fdatasync.
+        """
+        total = sum(len(c) for c in chunks)
+        align = self.DIRECT_ALIGN
+        if self._dfd is None or offset % align:
+            for c in chunks:
+                os.pwrite(self._fd, c, offset)
+                offset += len(c)
+            os.fdatasync(self._fd)
+            return
+        padded = -(-total // align) * align
+        with self._dlock:
+            if self._dbuf is None or len(self._dbuf) < padded:
+                self._dbuf = mmap.mmap(-1, max(padded, 1 << 20))
+            pos = 0
+            for c in chunks:
+                self._dbuf[pos : pos + len(c)] = c
+                pos += len(c)
+            if padded > total:
+                self._dbuf[total:padded] = b"\x00" * (padded - total)
+            os.pwrite(self._dfd, memoryview(self._dbuf)[:padded], offset)
+            # Belt-and-braces coherency with the buffered read fd: the
+            # kernel invalidates cached pages after a direct write, but
+            # open(2) warns the invalidate can fail/race a concurrent
+            # buffered read — drop the range explicitly so a later pread
+            # can never serve bytes from before this write.
+            try:
+                os.posix_fadvise(
+                    self._fd, offset, padded, os.POSIX_FADV_DONTNEED
+                )
+            except OSError:
+                pass
+
+    def writeback_kick(self, offset: int, nbytes: int) -> None:
+        """Start async writeback of a buffered range (no durability)."""
+        _sync_file_range(self._fd, offset, nbytes)
 
     def sync(self) -> None:
         # fdatasync suffices: the file's size is fixed at format time, so
@@ -177,3 +296,6 @@ class FileStorage:
 
     def close(self) -> None:
         os.close(self._fd)
+        if self._dfd is not None:
+            os.close(self._dfd)
+            self._dfd = None

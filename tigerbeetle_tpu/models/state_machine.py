@@ -243,11 +243,27 @@ class StateMachine:
         self.prepare_timestamp = 0
         self.commit_timestamp = 0
 
+        # Deferred object-store work for the LAST committed batch:
+        # (records, ts override). The reply depends only on validate+post,
+        # so the commit path sends it before storing; flush_deferred runs
+        # before anything that reads the store (every public operation
+        # guards, and the replica's _finish_commit flushes in strict op
+        # order for determinism).
+        self._deferred_store = None
+
         # telemetry: how many batches took which path
         self.stats = {
             "fast_batches": 0, "exact_batches": 0,
             "serial_batches": 0, "bail_batches": 0,
         }
+
+    def flush_deferred(self) -> None:
+        d = self._deferred_store
+        if d is not None:
+            self._deferred_store = None
+            recs, ts = d
+            with tracer.span("sm.ct.store"):
+                self._store_new_transfers(recs, ts=ts)
 
     def _store_new_transfers(self, recs: np.ndarray, ts=None) -> None:
         """Append committed transfers to the object log and both indexes
@@ -348,6 +364,7 @@ class StateMachine:
         inside the commit apply path — WAL replay re-runs the identical
         beat sequence, so grid allocation order (and therefore checkpoint
         bytes) stays deterministic across replicas and restarts."""
+        self.flush_deferred()  # the op's store precedes its beat, always
         self.transfer_log.flush_pending(max_blocks)
         self.history.flush_pending(max_blocks)
         self.transfer_index.compact_step()
@@ -398,6 +415,7 @@ class StateMachine:
     # create_accounts
 
     def create_accounts(self, events: np.ndarray, timestamp: Optional[int] = None) -> np.ndarray:
+        self.flush_deferred()
         events = np.atleast_1d(events)
         n = len(events)
         if timestamp is None:
@@ -561,6 +579,7 @@ class StateMachine:
                 pend, maybe, bits)
 
     def create_transfers(self, events: np.ndarray, timestamp: Optional[int] = None) -> np.ndarray:
+        self.flush_deferred()
         events = np.atleast_1d(events)
         n = len(events)
         if timestamp is None:
@@ -788,15 +807,17 @@ class StateMachine:
             return self._create_transfers_serial(events, timestamp)
         self.stats["fast_batches"] += 1
         if np.any(ok):
-            with tracer.span("sm.ct.store"):
-                if ok.all():
-                    # Zero-copy: the log's append stamps timestamps during
-                    # its own copy; `events` is never mutated.
-                    self._store_new_transfers(events, ts=ts)
-                else:
-                    recs = events[ok].copy()
-                    recs["timestamp"] = ts[ok]
-                    self._store_new_transfers(recs)
+            # Defer the store past the reply send (replica._finish_commit
+            # flushes in op order): the reply is fully determined here.
+            if ok.all():
+                # Zero-copy: the log's append stamps timestamps during
+                # its own copy; `events` is never mutated (the view keeps
+                # the wire body alive via the array base).
+                self._deferred_store = (events, ts)
+            else:
+                recs = events[ok].copy()
+                recs["timestamp"] = ts[ok]
+                self._deferred_store = (recs, None)
             self.commit_timestamp = int(ts[ok][-1])
         return _codes_to_results(codes)
 
@@ -1390,6 +1411,7 @@ class StateMachine:
         return out
 
     def lookup_transfers(self, ids_lo: np.ndarray, ids_hi: np.ndarray) -> np.ndarray:
+        self.flush_deferred()
         keys = pack_keys(
             np.asarray(ids_lo, dtype=np.uint64), np.asarray(ids_hi, dtype=np.uint64)
         )
@@ -1402,6 +1424,7 @@ class StateMachine:
         an account-index range read + gather, O(account's transfers), not
         O(history) (reference ScanTree over the secondary index,
         scan_tree.zig:31)."""
+        self.flush_deferred()
         key = pack_keys(
             np.array([account_id & U64_MAX], dtype=np.uint64),
             np.array([account_id >> 64], dtype=np.uint64),

@@ -245,13 +245,14 @@ class Replica:
         self.commit_checksums: Dict[int, int] = {}
         self.checksum_floor = 0
 
-        # Optional WAL group-fsync batcher (vsr/journal.GroupSync): when
-        # set, prepare acks (self prepare_ok / backup PREPARE_OK) are
-        # deferred until the batched fdatasync lands — durability-before-ack
-        # is preserved while one fsync amortizes over every prepare in the
-        # batch. None = synchronous fsync per prepare (tests, simulator:
-        # deterministic single-thread semantics).
-        self.wal_group = None
+        # Optional WAL writer thread (vsr/journal.WalWriter): when set,
+        # prepare bodies are written O_DIRECT|O_DSYNC off the event loop
+        # and acks (self prepare_ok / backup PREPARE_OK) are deferred to
+        # the write's completion — durability-before-ack preserved while
+        # the DMA overlaps execution. None = synchronous write+fsync per
+        # prepare (tests, simulator: deterministic single-thread
+        # semantics).
+        self.wal_writer = None
 
     # ------------------------------------------------------------------
 
@@ -654,20 +655,22 @@ class Replica:
         )
         entry = Pipeline(prepare)
         self.pipeline.append(entry)
-        if self.wal_group is None:
+        if self.wal_writer is None:
             self.journal.write_prepare(prepare)
             entry.ok_from.add(self.replica)
             self._replicate_chain(prepare)
             self._check_pipeline_quorum()
         else:
-            # Async WAL: buffer the write (page cache), replicate NOW so the
-            # network overlaps the fsync (reference replica.zig:3034 starts
-            # replication before its WAL write completes), and grant our own
-            # prepare_ok only once the group fsync lands (ack-after-durable).
-            self.journal.write_prepare(prepare, sync=False)
-            self._replicate_chain(prepare)
+            # Async WAL: queue the durable body write on the writer thread,
+            # replicate NOW so the network overlaps the DMA (reference
+            # replica.zig:3034 starts replication before its WAL write
+            # completes), and grant our own prepare_ok only once the write
+            # lands (ack-after-durable).
             op, cks, view = self.op, ph["checksum"], self.view
-            self.wal_group.request(lambda: self._on_wal_durable(op, cks, view))
+            self.journal.write_prepare_async(
+                prepare, lambda: self._on_wal_durable(op, cks, view)
+            )
+            self._replicate_chain(prepare)
 
     def _on_wal_durable(self, op: int, checksum: int, view: int) -> None:
         """Group-fsync landed for our own prepare at `op`: grant the
@@ -757,16 +760,16 @@ class Replica:
             existing = self.journal.read_prepare(op)
             if existing is not None and existing.header["checksum"] == h["checksum"]:
                 self._drop_target(op)
-                # Ack-after-durable even for duplicates: with group commit
-                # the original write may still sit in the page cache (only
-                # the batched fdatasync makes it durable) — acking from the
-                # page-cache read would let the primary count a quorum an
-                # untimely power loss could revoke.
-                if self.wal_group is None:
+                # Ack-after-durable even for duplicates: the original body
+                # write may still be queued on the WAL writer — acking
+                # before it lands would let the primary count a quorum an
+                # untimely power loss could revoke. barrier() fires after
+                # every previously queued write is durable.
+                if self.wal_writer is None:
                     self._send_prepare_ok(h)
                     self._commit_journal(h["commit"])
                 else:
-                    self.wal_group.request(lambda: self._backup_wal_durable(h))
+                    self.wal_writer.barrier(lambda: self._backup_wal_durable(h))
                 return
             if (existing is None or h["view"] >= existing.header["view"]) and (
                 self.journal.can_write(op)
@@ -787,17 +790,18 @@ class Replica:
             self._repair_gaps(target=op)
             return
         self.op = op
-        if self.wal_group is None:
+        if self.wal_writer is None:
             self.journal.write_prepare(msg)
             self._replicate_chain(msg)
             self._send_prepare_ok(h)
             self._commit_journal(h["commit"])
         else:
-            # Buffer the write, forward down the chain immediately, and
-            # defer prepare_ok to the group fsync (ack-after-durable).
-            self.journal.write_prepare(msg, sync=False)
+            # Queue the durable write, forward down the chain immediately,
+            # and defer prepare_ok to completion (ack-after-durable).
+            self.journal.write_prepare_async(
+                msg, lambda: self._backup_wal_durable(h)
+            )
             self._replicate_chain(msg)
-            self.wal_group.request(lambda: self._backup_wal_durable(h))
 
     def _replicate_chain(self, prepare: Message) -> None:
         """Forward a freshly-accepted prepare down the replication chain
@@ -868,9 +872,14 @@ class Replica:
             self.commit_max = max(self.commit_max, op)
             reply = self._execute(entry.message)
             self.commit_min = op
-            self._maybe_checkpoint()
             if reply is not None:
+                # Reply first: it depends only on validate+post, and
+                # asyncio pushes it to the socket synchronously when the
+                # buffer is empty — the client pipelines its next request
+                # against our store/compaction work below.
                 self.bus.send_to_client(entry.message.header["client"], reply)
+            self._finish_commit()
+            self._maybe_checkpoint()
         while self.request_queue and len(self.pipeline) < self.config.pipeline_max:
             self._primary_prepare(self.request_queue.pop(0))
 
@@ -951,6 +960,7 @@ class Replica:
             self._execute(msg)
             self.commit_min += 1
             self._drop_target(op)
+            self._finish_commit()
             self._maybe_checkpoint()
         if self.is_primary and self.pipeline:
             self._check_pipeline_quorum()
@@ -1832,7 +1842,25 @@ class Replica:
                 prepare, self.primary_index(prepare.header["view"]), self.replica
             )
         with tracer.span("replica.execute"):
-            return self._execute_inner(prepare, replay)
+            reply = self._execute_inner(prepare, replay)
+        if replay:
+            # Replay has no reply to race ahead of: finish the op's apply
+            # sequence inline (live commit paths call _finish_commit after
+            # the reply send — same per-op order either way).
+            self._finish_commit()
+        return reply
+
+    def _finish_commit(self) -> None:
+        """Deferred tail of the per-op apply sequence: the state machine's
+        deferred object store, then the compaction beat. Runs AFTER the
+        reply hits the wire (the reply depends only on validate+post) but
+        in the identical per-op order as replay — store(N) → beat(N) →
+        anything of N+1 — so grid allocation order stays deterministic
+        across replicas and restarts (checked byte-for-byte by the
+        storage checker)."""
+        sm = self.state_machine
+        sm.flush_deferred()
+        sm.compact_beat()
 
     def _execute_inner(self, prepare: Message, replay: bool = False) -> Optional[Message]:
         h = prepare.header
@@ -1944,11 +1972,10 @@ class Replica:
             + int(h["checksum_body"]).to_bytes(16, "little")
             + results
         )
-        # One compaction beat per committed op, INSIDE the apply path so
-        # WAL replay re-runs the identical beat sequence (deterministic
-        # grid allocation order — reference forest.compact per op,
-        # forest.zig:319, paced by op number).
-        sm.compact_beat()
+        # One compaction beat per committed op, in the apply sequence via
+        # _finish_commit (after the reply send) so WAL replay re-runs the
+        # identical beat sequence (deterministic grid allocation order —
+        # reference forest.compact per op, forest.zig:319).
         self.committed_timestamp_max = max(
             self.committed_timestamp_max, int(h["timestamp"])
         )
