@@ -38,6 +38,20 @@ _BLOCK_HEADER_DTYPE = np.dtype(
 assert _BLOCK_HEADER_DTYPE.itemsize == BLOCK_HEADER_SIZE
 
 
+class GridReadFault(IOError):
+    """A grid block failed its checksum on read. Carries the index and
+    the expected payload checksum (from the RAM identity map; None when
+    untracked) so the replica can repair the single block from a peer in
+    normal operation — the reference's always-on block-repair protocol
+    (grid_blocks_missing.zig:513, replica.zig:2289,2413), not a sync
+    mode. Subclasses IOError so pre-existing handlers keep working."""
+
+    def __init__(self, index: int, expected: Optional[int]) -> None:
+        super().__init__(f"grid block {index} corrupt")
+        self.index = int(index)
+        self.expected = expected
+
+
 class FreeSet:
     """Bitset allocator for grid blocks (reference free_set.zig).
 
@@ -213,7 +227,9 @@ class Grid:
         self._cache_put(index, bytes(payload))
 
     def read_block(self, index: int) -> bytes:
-        """Return the payload; raises on checksum mismatch (corrupt block)."""
+        """Return the payload; raises GridReadFault on checksum mismatch
+        (corrupt block) — the replica repairs the block from a peer in
+        normal operation (reference grid_blocks_missing.zig:513)."""
         cached = self._cache.get(index)
         if cached is not None:
             self._cache.move_to_end(index)
@@ -226,7 +242,7 @@ class Grid:
         payload = raw[BLOCK_HEADER_SIZE : BLOCK_HEADER_SIZE + size]
         want = int(head["checksum_lo"]) | (int(head["checksum_hi"]) << 64)
         if size > self.payload_max or _checksum(payload) != want:
-            raise IOError(f"grid block {index} corrupt")
+            raise GridReadFault(index, self.block_cks.get(index))
         self._cache_put(index, payload)
         return payload
 
@@ -267,6 +283,15 @@ class Grid:
         else:
             self.free_set.release(index)
         self._cache.pop(index, None)
+
+    def abort_block(self, index: int) -> None:
+        """IMMEDIATELY un-acquire a freshly written, never-referenced
+        block (an aborted compaction job's output). Unlike release(),
+        never staged: the retried job must re-acquire the exact same
+        indices (lowest-free-first) for deterministic layout."""
+        self.free_set.release(index)
+        self._cache.pop(index, None)
+        self.block_cks.pop(index, None)
 
     def commit_releases(self) -> None:
         self.free_set.commit_staged()

@@ -30,7 +30,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from tigerbeetle_tpu.io.grid import Grid
+from tigerbeetle_tpu.io.grid import Grid, GridReadFault
 from tigerbeetle_tpu.lsm.store import (
     KEY_DTYPE,
     NOT_FOUND,
@@ -338,8 +338,19 @@ class DurableIndex:
                     break
         if self._job is None:
             return False
-        if self._job.step(quota_entries):
-            self._install_job()
+        try:
+            if self._job.step(quota_entries):
+                self._install_job()
+        except GridReadFault:
+            # A corrupt input block: the step is NOT resumable (streams
+            # were partially consumed), but abort-and-retry is exactly
+            # deterministic — the writer's freshly acquired blocks are
+            # un-acquired immediately, so after the replica repairs the
+            # block from a peer, the restarted job re-acquires the same
+            # lowest-free indices and produces identical output.
+            self._job.writer.abort()
+            self._job = None
+            raise
         return self._job is not None or any(
             len(t) > self.growth for t in self.levels
         )
@@ -715,6 +726,20 @@ class _TableWriter:
         self.fences: List[tuple] = []
         self.total = 0
         self.done: List[TableInfo] = []
+
+    def abort(self) -> None:
+        """Un-acquire every grid block this writer has produced (aborted
+        compaction job): none is referenced by any manifest yet, and the
+        retried job must re-acquire the same indices."""
+        for _fh, _fl, _lh, _ll, block, _c in self.fences:
+            self.tree.grid.abort_block(block)
+        for t in self.done:
+            for f in self.tree._table_fences(t):
+                self.tree.grid.abort_block(int(f["block"]))
+            self.tree.grid.abort_block(t.index_block)
+        self.fences = []
+        self.done = []
+        self.parts_k, self.parts_v, self.buffered = [], [], 0
 
     def append(self, keys: np.ndarray, vals: np.ndarray) -> None:
         if len(keys) == 0:

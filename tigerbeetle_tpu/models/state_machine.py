@@ -250,6 +250,9 @@ class StateMachine:
         # guards, and the replica's _finish_commit flushes in strict op
         # order for determinism).
         self._deferred_store = None
+        # Resume point within compact_beat's stage list after a
+        # GridReadFault was repaired (see compact_beat).
+        self._beat_stage = 0
 
         # telemetry: how many batches took which path
         self.stats = {
@@ -365,12 +368,23 @@ class StateMachine:
         beat sequence, so grid allocation order (and therefore checkpoint
         bytes) stays deterministic across replicas and restarts."""
         self.flush_deferred()  # the op's store precedes its beat, always
-        self.transfer_log.flush_pending(max_blocks)
-        self.history.flush_pending(max_blocks)
-        self.transfer_index.compact_step()
-        self.account_rows.compact_step()
-        self.posted.compact_step()
-        self.history.compact_step()
+        # Stage-resumable: a GridReadFault mid-beat (corrupt compaction
+        # input) aborts that stage atomically (tree-level abort_block) and
+        # the RETRY after repair resumes at the faulted stage — re-running
+        # completed stages would give their trees extra beats for this op
+        # and diverge the deterministic allocation order from peers.
+        stages = (
+            lambda: self.transfer_log.flush_pending(max_blocks),
+            lambda: self.history.flush_pending(max_blocks),
+            self.transfer_index.compact_step,
+            self.account_rows.compact_step,
+            self.posted.compact_step,
+            self.history.compact_step,
+        )
+        while self._beat_stage < len(stages):
+            stages[self._beat_stage]()
+            self._beat_stage += 1
+        self._beat_stage = 0
 
     # ------------------------------------------------------------------
     # balances access (device or host backend)

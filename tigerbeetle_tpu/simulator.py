@@ -102,6 +102,17 @@ class Simulator:
             self.promote_at[t + rng.randint(100, 400)] = (
                 self.replica_count, victim
             )
+        # Grid-corruption schedule (normal-operation block repair,
+        # reference grid_blocks_missing.zig): smash one flushed grid
+        # block on a live replica mid-run; it must repair the block from
+        # a peer — commits gated, no state sync — and stay byte-
+        # convergent. Multi-replica only (a solo replica fail-stops).
+        # Keyed on request progress, not ticks: short runs finish before
+        # any fixed tick window.
+        self.corrupt_grid_after: int | None = (
+            rng.randint(3, max(3, requests // 2))
+            if self.replica_count >= 2 and rng.random() < 0.5 else None
+        )
         self.log = []
 
     def run(self, tick_budget: int = 200_000) -> int:
@@ -144,6 +155,30 @@ class Simulator:
             if tick in self.heal_at:
                 cl.net.heal()
                 self.log.append((tick, "heal"))
+            if (
+                self.corrupt_grid_after is not None
+                and self.workload.requests_done >= self.corrupt_grid_after
+            ):
+                candidates = [
+                    (i, r)
+                    for i, r in enumerate(cl.replicas[: self.replica_count])
+                    if r is not None and i not in down
+                    and len(r.state_machine.transfer_log.blocks) > 0
+                ]
+                # Keep the trigger armed until some replica has actually
+                # flushed a log block to corrupt.
+                if candidates:
+                    self.corrupt_grid_after = None
+                    i, r = candidates[self.rng.randrange(len(candidates))]
+                    grid = r.state_machine.grid
+                    blocks = r.state_machine.transfer_log.blocks
+                    block = blocks[self.rng.randrange(len(blocks))]
+                    cl.storages[i].write(grid._addr(block), b"\xa5" * 64)
+                    cl.storages[i].sync()
+                    grid.drop_cache()
+                    self.log.append(
+                        (tick, f"corrupt grid block {block} on replica {i}")
+                    )
             if tick in self.promote_at:
                 s_ix, target = self.promote_at[tick]
                 if target in down and cl.replicas[s_ix] is not None:

@@ -31,6 +31,7 @@ import numpy as np
 from tigerbeetle_tpu import types
 from tigerbeetle_tpu import tracer
 from tigerbeetle_tpu.constants import Config
+from tigerbeetle_tpu.io.grid import GridReadFault
 from tigerbeetle_tpu.io.storage import Zone
 from tigerbeetle_tpu.models.state_machine import StateMachine
 from tigerbeetle_tpu.vsr import header as hdr
@@ -218,6 +219,17 @@ class Replica:
         # Block-level sync progress: {missing: {index: cks}, requested,
         # peer, last_tick, stalls, fetched}; commits are gated while set.
         self._block_sync: Optional[dict] = None
+        # Normal-operation grid repair (reference grid_blocks_missing.zig:
+        # block repair is an always-on protocol, not a sync mode): a
+        # corrupt block read during commit/query raises GridReadFault; the
+        # op is requeued, the block fetched from a peer, rewritten in
+        # place, and the op retried. Commits gate while active so the
+        # deterministic allocation order is preserved (a replica that
+        # skipped a compaction beat would diverge byte-wise).
+        self._grid_repair: Optional[dict] = None
+        # The _finish_commit (store/compaction) of an already-committed op
+        # faulted: it must complete after repair BEFORE any further op.
+        self._finish_pending = False
 
         # Injected time + cluster clock (reference clock.zig via ping/pong
         # offset samples; DeterministicTime keeps simulations reproducible).
@@ -333,7 +345,29 @@ class Replica:
                     block_cks_map=resume_block_sync,
                 )
             else:
-                self._load_snapshot(blob)
+                try:
+                    self._load_snapshot(blob)
+                except GridReadFault:
+                    # A checkpoint-referenced block is corrupt on disk
+                    # (latent sector error found at boot — the bloom
+                    # rebuild scans every log block): install the RAM
+                    # state without the scan and fetch ONLY the bad
+                    # blocks via block-level sync. (Blocks written after
+                    # the checkpoint are deterministically rewritten by
+                    # WAL replay and need no repair.)
+                    if self.replica_count == 1:
+                        raise  # no peer to repair from: fail-stop loudly
+                    tracer.count("mark.open_grid_corrupt")
+                    log.warning(
+                        "replica %d: corrupt checkpoint-referenced grid "
+                        "block at open — fetching via block sync",
+                        self.replica,
+                    )
+                    resume_block_sync = snapshot.block_checksums(blob)
+                    snapshot.install(
+                        self, blob, rebuild_bloom=False,
+                        block_cks_map=resume_block_sync,
+                    )
             # The encoded free set covers content blocks only; the
             # trailer's own (per-replica) blocks are re-marked from the
             # superblock reference.
@@ -391,6 +425,7 @@ class Replica:
         if self.replica_count > 1 and self.tick_count % PING_TIMEOUT == 0:
             self._send_clock_pings()
         self._sync_tick()
+        self._grid_repair_tick()
         if self.status == STATUS_NORMAL:
             if self.is_primary:
                 if self.tick_count - self.last_commit_sent_tick >= COMMIT_HEARTBEAT_TIMEOUT:
@@ -864,13 +899,31 @@ class Replica:
             if len(entry.ok_from) < self.quorum_replication:
                 break
             op = entry.message.header["op"]
+            if op <= self.commit_min:
+                # Already committed through the journal path (e.g. while a
+                # grid repair had the pipeline gated): drop the stale head
+                # — the client recovers its reply from the session cache
+                # on resend; executing again would double-apply.
+                self.pipeline.pop(0)
+                continue
             if op != self.commit_min + 1:
                 # Earlier ops (from before a view change) must commit through
                 # the journal first; _commit_journal re-checks the pipeline.
                 break
+            if self._grid_repair is not None or self._finish_pending:
+                break  # a block repair is in flight: commits are gated
             self.pipeline.pop(0)
             self.commit_max = max(self.commit_max, op)
-            reply = self._execute(entry.message)
+            try:
+                reply = self._execute(entry.message)
+            except GridReadFault as fault:
+                # Every grid read in an op precedes its first durable
+                # mutation (prefetch/dup-check/lazy-oracle reads come
+                # first; store paths only write), so the op is cleanly
+                # retryable: requeue it and repair the one block.
+                self.pipeline.insert(0, entry)
+                self._begin_grid_repair(fault)
+                break
             self.commit_min = op
             if reply is not None:
                 # Reply first: it depends only on validate+post, and
@@ -878,7 +931,14 @@ class Replica:
                 # buffer is empty — the client pipelines its next request
                 # against our store/compaction work below.
                 self.bus.send_to_client(entry.message.header["client"], reply)
-            self._finish_commit()
+            try:
+                self._finish_commit()
+            except GridReadFault as fault:
+                # Already committed; the deferred store/beat must finish
+                # after repair BEFORE any further op executes.
+                self._finish_pending = True
+                self._begin_grid_repair(fault)
+                break
             self._maybe_checkpoint()
         while self.request_queue and len(self.pipeline) < self.config.pipeline_max:
             self._primary_prepare(self.request_queue.pop(0))
@@ -951,16 +1011,27 @@ class Replica:
             # could read a grid block that has not arrived yet. Commits
             # resume from _finish_block_sync.
             return
+        if self._grid_repair is not None or self._finish_pending:
+            return  # a block repair is in flight: commits are gated
         while self.commit_min < self.commit_max:
             op = self.commit_min + 1
             msg = self.journal.read_prepare(op) if self._journal_has_target(op) else None
             if msg is None:
                 self._repair_gaps(target=op)
                 break
-            self._execute(msg)
+            try:
+                self._execute(msg)
+            except GridReadFault as fault:
+                self._begin_grid_repair(fault)
+                break
             self.commit_min += 1
             self._drop_target(op)
-            self._finish_commit()
+            try:
+                self._finish_commit()
+            except GridReadFault as fault:
+                self._finish_pending = True
+                self._begin_grid_repair(fault)
+                break
             self._maybe_checkpoint()
         if self.is_primary and self.pipeline:
             self._check_pipeline_quorum()
@@ -1289,6 +1360,13 @@ class Replica:
         # ident) must neither crash the replica loop nor destroy state.
         if not snapshot.validate(blob):
             return
+        # A state sync supersedes any in-flight normal-operation grid
+        # repair: the installed checkpoint replaces the state the faulted
+        # op would have produced, so the repair gates (and any half-done
+        # beat resume point) are void.
+        self._grid_repair = None
+        self._finish_pending = False
+        self.state_machine._beat_stage = 0
         from tigerbeetle_tpu.io.grid import FreeSet
 
         grid = self.state_machine.grid
@@ -1446,6 +1524,8 @@ class Replica:
     def on_block(self, msg: Message) -> None:
         s = self._block_sync
         if s is None:
+            if self._grid_repair is not None:
+                self._on_repair_block(msg)
             return
         h = msg.header
         index = h["op"]
@@ -1467,6 +1547,119 @@ class Replica:
             self._request_missing_blocks()
         else:
             self._finish_block_sync()
+
+    # --- normal-operation grid repair -----------------------------------
+    # (reference grid_blocks_missing.zig:513 + replica.zig:2289,2413:
+    # block repair is an always-on protocol — a single corrupt block is
+    # fetched from a peer and rewritten in place, no state sync.)
+
+    GRID_REPAIR_RETRY_TICKS = 50
+
+    def _begin_grid_repair(self, fault: GridReadFault) -> None:
+        if self.replica_count == 1 or fault.expected is None:
+            # No peer to repair from, or the block's identity is unknown
+            # (not in the RAM map nor any loaded trailer): fail-stop
+            # loudly — restart-from-checkpoint or operator intervention.
+            raise fault
+        if self._grid_repair is None:
+            self._grid_repair = {
+                "missing": {}, "last_tick": self.tick_count, "peer": None,
+            }
+        self._grid_repair["missing"][fault.index] = fault.expected
+        tracer.count("mark.grid_repair_begin")
+        log.warning(
+            "replica %d: grid block %d corrupt in normal operation — "
+            "repairing from a peer", self.replica, fault.index,
+        )
+        self._send_grid_repair_requests()
+
+    def _send_grid_repair_requests(self, rotate: bool = False) -> None:
+        s = self._grid_repair
+        if s is None or not s["missing"]:
+            return
+        peer = s.get("peer")
+        if peer is None:
+            peer = self._repair_peer()
+        elif rotate:
+            peer = (peer + 1) % self.replica_count
+            if peer == self.replica:
+                peer = (peer + 1) % self.replica_count
+        s["peer"] = peer
+        s["last_tick"] = self.tick_count
+        wanted = sorted(s["missing"])
+        for i in range(0, len(wanted), self.BLOCKS_PER_REQUEST):
+            body = np.array(
+                wanted[i : i + self.BLOCKS_PER_REQUEST], dtype=np.uint32
+            ).tobytes()
+            rq = hdr.make(
+                Command.REQUEST_BLOCKS, self.cluster,
+                view=self.view, replica=self.replica,
+            )
+            self.bus.send_to_replica(peer, Message(rq, body).seal())
+
+    def _grid_repair_tick(self) -> None:
+        s = self._grid_repair
+        if s is None:
+            return
+        if self.tick_count - s["last_tick"] >= self.GRID_REPAIR_RETRY_TICKS:
+            s["stalls"] = s.get("stalls", 0) + 1
+            self._send_grid_repair_requests(rotate=True)
+            if s["stalls"] % 4 == 0:
+                # The wanted block version may be GONE cluster-wide: once
+                # every peer checkpointed past our gated commit point, the
+                # block's index can be reused for new content and every
+                # served BLOCK fails our checksum check. Probe with
+                # REQUEST_PREPARE for our next commit: a peer whose WAL
+                # still covers it serves the prepare (harmless), one that
+                # checkpointed past it starts the chunked state sync that
+                # replaces our whole state (clearing the repair gates in
+                # _install_sync_checkpoint). Commit gates STAY UP until
+                # then — resuming without the missed store/beat would
+                # diverge the deterministic layout.
+                peer = s.get("peer")
+                if peer is not None and peer != self.replica:
+                    rq = hdr.make(
+                        Command.REQUEST_PREPARE, self.cluster,
+                        view=self.view, op=self.commit_min + 1,
+                        replica=self.replica,
+                    )
+                    self.bus.send_to_replica(peer, Message(rq).seal())
+
+    def _on_repair_block(self, msg: Message) -> None:
+        s = self._grid_repair
+        h = msg.header
+        index = int(h["op"])
+        want = s["missing"].get(index)
+        if want is None or hdr.checksum(msg.body) != want:
+            return  # not ours / stale content: the retry tick re-requests
+        grid = self.state_machine.grid
+        grid.write_block_at(index, msg.body, int(h["request"]))
+        del s["missing"][index]
+        tracer.count("mark.grid_repair_block")
+        if s["missing"]:
+            return
+        self._grid_repair = None
+        self.storage.sync()  # the repaired block must survive a restart
+        log.info("replica %d: grid repair complete", self.replica)
+        tracer.count("mark.grid_repair_done")
+        self.on_event("grid_repair", self)
+        if self._finish_pending:
+            self._finish_pending = False
+            try:
+                self._finish_commit()
+            except GridReadFault as fault:
+                self._finish_pending = True
+                self._begin_grid_repair(fault)
+                return
+            self._maybe_checkpoint()
+        # Resume the gated commit stream. A primary with a requeued
+        # pipeline head MUST resume through the pipeline (committing the
+        # op via the journal path would discard its client reply and
+        # leave the stale head wedging the pipeline forever).
+        if self.is_primary and self.pipeline:
+            self._check_pipeline_quorum()
+        else:
+            self._commit_journal(self.commit_max)
 
     def _finish_block_sync(self) -> None:
         """Every referenced block present: make them durable, clear the
