@@ -239,6 +239,24 @@ def _repl_execute(client, op: str, rest: str, types) -> None:
                 "debits_posted": types.u128_of(r, "debits_posted"),
                 "credits_posted": types.u128_of(r, "credits_posted"),
             })
+    elif op in ("query_accounts", "query_transfers"):
+        kw = {
+            k: objs[0].get(k, 0)
+            for k in (
+                "user_data_128", "user_data_64", "user_data_32",
+                "ledger", "code", "timestamp_min", "timestamp_max",
+            )
+        } if objs else {}
+        if objs and "limit" in objs[0]:
+            kw["limit"] = objs[0]["limit"]
+        recs = getattr(client, op)(**kw)
+        print(f"{len(recs)} rows")
+        for r in recs[:10]:
+            print({
+                "id": types.u128_of(r, "id"),
+                "timestamp": int(r["timestamp"]),
+                "ledger": int(r["ledger"]), "code": int(r["code"]),
+            })
     else:
         print(f"unknown operation: {op}")
 

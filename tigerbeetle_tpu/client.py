@@ -297,6 +297,51 @@ class Client:
         )
         return np.frombuffer(bytearray(reply.body), dtype=types.ACCOUNT_BALANCE_DTYPE)
 
+    @staticmethod
+    def _query_body(
+        user_data_128: int, user_data_64: int, user_data_32: int,
+        ledger: int, code: int, timestamp_min: int, timestamp_max: int,
+        limit: int, flags: int,
+    ) -> bytes:
+        f = np.zeros(1, dtype=types.QUERY_FILTER_DTYPE)
+        f[0]["user_data_128_lo"] = user_data_128 & types.U64_MAX
+        f[0]["user_data_128_hi"] = user_data_128 >> 64
+        f[0]["user_data_64"] = user_data_64
+        f[0]["user_data_32"] = user_data_32
+        f[0]["ledger"] = ledger
+        f[0]["code"] = code
+        f[0]["timestamp_min"] = timestamp_min
+        f[0]["timestamp_max"] = timestamp_max
+        f[0]["limit"] = limit
+        f[0]["flags"] = flags
+        return f.tobytes()
+
+    def query_accounts(
+        self, user_data_128: int = 0, user_data_64: int = 0,
+        user_data_32: int = 0, ledger: int = 0, code: int = 0,
+        timestamp_min: int = 0, timestamp_max: int = 0,
+        limit: int = 8190, flags: int = 0,
+    ) -> np.ndarray:
+        """Equality query: zero fields are ignored, nonzero fields ANDed;
+        flags bit 0 = reversed (newest first)."""
+        reply = self._roundtrip(Operation.QUERY_ACCOUNTS, self._query_body(
+            user_data_128, user_data_64, user_data_32, ledger, code,
+            timestamp_min, timestamp_max, limit, flags,
+        ))
+        return np.frombuffer(bytearray(reply.body), dtype=types.ACCOUNT_DTYPE)
+
+    def query_transfers(
+        self, user_data_128: int = 0, user_data_64: int = 0,
+        user_data_32: int = 0, ledger: int = 0, code: int = 0,
+        timestamp_min: int = 0, timestamp_max: int = 0,
+        limit: int = 8190, flags: int = 0,
+    ) -> np.ndarray:
+        reply = self._roundtrip(Operation.QUERY_TRANSFERS, self._query_body(
+            user_data_128, user_data_64, user_data_32, ledger, code,
+            timestamp_min, timestamp_max, limit, flags,
+        ))
+        return np.frombuffer(bytearray(reply.body), dtype=types.TRANSFER_DTYPE)
+
 
 class AsyncClient:
     """Pipelined asyncio client: a pool of VSR sessions over one loop.

@@ -39,6 +39,7 @@ from tigerbeetle_tpu.lsm.store import (
 )
 
 ENTRY_SIZE = KEY_DTYPE.itemsize + 4  # key + u32 value
+U64_MAX = (1 << 64) - 1
 
 # Per-data-block fence in the index block.
 INDEX_ENTRY_DTYPE = np.dtype(
@@ -580,6 +581,66 @@ class DurableIndex:
         if not parts:
             return np.zeros(0, dtype=np.uint32)
         return np.sort(np.concatenate(parts), kind="stable")
+
+    def scan_lo_capped(
+        self, k_lo: int, hi_min: int = 0, hi_max: int = U64_MAX,
+        cap: int = 1 << 16,
+    ) -> Tuple[np.ndarray, bool]:
+        """scan_lo with an abandon threshold: once more than `cap` values
+        have accumulated the scan stops and reports incomplete (False) —
+        an unselective predicate is cheaper to re-verify on the gathered
+        candidate rows than to materialize and sort in full (reference
+        scan_builder picks scan order by selectivity; this is the
+        batch-vectorized analog)."""
+        assert not self.unique
+        k_lo = np.uint64(k_lo)
+        parts: List[np.ndarray] = []
+        total = 0
+        for table in self._tables_newest_first():
+            fences = self._table_fences(table)
+            b_lo = int(np.searchsorted(fences["last_lo"], k_lo, side="left"))
+            b_hi = int(np.searchsorted(fences["first_lo"], k_lo, side="right"))
+            for b in range(b_lo, min(b_hi, len(fences))):
+                bk, bv = self._read_data_block(
+                    int(fences[b]["block"]), int(fences[b]["count"])
+                )
+                s = np.searchsorted(bk["lo"], k_lo, side="left")
+                e = np.searchsorted(bk["lo"], k_lo, side="right")
+                if e > s:
+                    run_hi = bk["hi"][s:e]
+                    hs = np.searchsorted(run_hi, np.uint64(hi_min), side="left")
+                    he = np.searchsorted(run_hi, np.uint64(hi_max), side="right")
+                    if he > hs:
+                        parts.append(bv[s + hs : s + he])
+                        total += he - hs
+                        if total > cap:
+                            return np.concatenate(parts), False
+        self._sort_mem_lazily()
+        for mem_keys, mem_vals in self._mem:
+            hit = (
+                (mem_keys["lo"] == k_lo)
+                & (mem_keys["hi"] >= np.uint64(hi_min))
+                & (mem_keys["hi"] <= np.uint64(hi_max))
+            )
+            if hit.any():
+                parts.append(mem_vals[hit])
+                total += int(hit.sum())
+                if total > cap:
+                    return np.concatenate(parts), False
+        if not parts:
+            return np.zeros(0, dtype=np.uint32), True
+        return np.sort(np.concatenate(parts), kind="stable"), True
+
+    def scan_lo(self, k_lo: int, hi_min: int = 0, hi_max: int = U64_MAX) -> np.ndarray:
+        """All values whose key.lo == k_lo and key.hi ∈ [hi_min, hi_max],
+        ascending by value. The composite-key scan primitive (reference
+        scan_tree.zig:31 range scans over (field, timestamp) keys,
+        composite_key.zig): key.lo carries the field prefix, key.hi the
+        timestamp, so this is 'rows matching field=value in a timestamp
+        window'."""
+        vals, complete = self.scan_lo_capped(k_lo, hi_min, hi_max, cap=1 << 62)
+        assert complete
+        return vals
 
     # --- checkpoint -----------------------------------------------------
 

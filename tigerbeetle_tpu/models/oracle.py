@@ -716,3 +716,62 @@ class Oracle:
                              row.cr_credits_pending, row.cr_credits_posted))
         rows.sort(key=lambda r: r[0], reverse=bool(flags & AccountFilterFlags.REVERSED))
         return rows[:limit]
+
+    # --- index-backed equality queries (upstream QueryFilter semantics:
+    # zero fields ignored, nonzero fields ANDed; flags bit 0 = reversed) --
+
+    @staticmethod
+    def _query_filter_valid(
+        timestamp_min: int, timestamp_max: int, limit: int, flags: int
+    ) -> bool:
+        return (
+            timestamp_min != U64_MAX
+            and timestamp_max != U64_MAX
+            and (timestamp_max == 0 or timestamp_min <= timestamp_max)
+            and limit != 0
+            and not (flags & ~1)
+        )
+
+    def query_transfers(
+        self, user_data_128: int = 0, user_data_64: int = 0,
+        user_data_32: int = 0, ledger: int = 0, code: int = 0,
+        timestamp_min: int = 0, timestamp_max: int = 0,
+        limit: int = 8190, flags: int = 0,
+    ) -> List[Transfer]:
+        if not self._query_filter_valid(timestamp_min, timestamp_max, limit, flags):
+            return []
+        ts_min = timestamp_min if timestamp_min else 1
+        ts_max = timestamp_max if timestamp_max else U64_MAX - 1
+        matches = [
+            t for t in self.transfers.values()
+            if ts_min <= t.timestamp <= ts_max
+            and (not user_data_128 or t.user_data_128 == user_data_128)
+            and (not user_data_64 or t.user_data_64 == user_data_64)
+            and (not user_data_32 or t.user_data_32 == user_data_32)
+            and (not ledger or t.ledger == ledger)
+            and (not code or t.code == code)
+        ]
+        matches.sort(key=lambda t: t.timestamp, reverse=bool(flags & 1))
+        return [t.copy() for t in matches[:limit]]
+
+    def query_accounts(
+        self, user_data_128: int = 0, user_data_64: int = 0,
+        user_data_32: int = 0, ledger: int = 0, code: int = 0,
+        timestamp_min: int = 0, timestamp_max: int = 0,
+        limit: int = 8190, flags: int = 0,
+    ) -> List[Account]:
+        if not self._query_filter_valid(timestamp_min, timestamp_max, limit, flags):
+            return []
+        ts_min = timestamp_min if timestamp_min else 1
+        ts_max = timestamp_max if timestamp_max else U64_MAX - 1
+        matches = [
+            a for a in self.accounts.values()
+            if ts_min <= a.timestamp <= ts_max
+            and (not user_data_128 or a.user_data_128 == user_data_128)
+            and (not user_data_64 or a.user_data_64 == user_data_64)
+            and (not user_data_32 or a.user_data_32 == user_data_32)
+            and (not ledger or a.ledger == ledger)
+            and (not code or a.code == code)
+        ]
+        matches.sort(key=lambda a: a.timestamp, reverse=bool(flags & 1))
+        return [a.copy() for a in matches[:limit]]

@@ -223,6 +223,14 @@ class StateMachine:
             self.grid, unique=False,
             memtable_max=config.index_memtable_rows, backend=backend,
         )
+        # Combined secondary query index: (tag<<56 | fold56(field value),
+        # timestamp) -> row, for the 8 indexed transfer fields beyond
+        # id/dr/cr (reference: one LSM tree per field,
+        # state_machine.zig:198-219; see lsm/scan.py for the re-shape).
+        self.query_rows = DurableIndex(
+            self.grid, unique=False,
+            memtable_max=config.index_memtable_rows, backend=backend,
+        )
         self.transfer_log = DurableLog(self.grid, types.TRANSFER_DTYPE)
         # Transfer-id membership pre-filter (no false negatives): keeps the
         # per-batch duplicate-id check O(batch) instead of O(tables).
@@ -277,18 +285,63 @@ class StateMachine:
         with tracer.span("sm.store.log"):
             rows = self.transfer_log.append_batch(recs, ts=ts)
             self.transfer_seen.add(recs["id_lo"], recs["id_hi"])
-        if self._store_native(recs, int(rows[0]) if len(rows) else 0):
-            return
-        with tracer.span("sm.store.idx"):
-            self.transfer_index.insert_batch(
-                pack_keys(recs["id_lo"], recs["id_hi"]), rows
+        if not self._store_native(recs, int(rows[0]) if len(rows) else 0):
+            with tracer.span("sm.store.idx"):
+                self.transfer_index.insert_batch(
+                    pack_keys(recs["id_lo"], recs["id_hi"]), rows
+                )
+            with tracer.span("sm.store.rows"):
+                acct_keys = np.concatenate([
+                    pack_keys(recs["debit_account_id_lo"], recs["debit_account_id_hi"]),
+                    pack_keys(recs["credit_account_id_lo"], recs["credit_account_id_hi"]),
+                ])
+                self.account_rows.insert_batch(
+                    acct_keys, np.concatenate([rows, rows])
+                )
+        self._store_query_index(recs, rows, ts)
+
+    def _store_query_index(self, recs: np.ndarray, rows: np.ndarray, ts) -> None:
+        """One batched append of the secondary-index entries for the
+        committed rows (tagged composite keys — lsm/scan.py).
+
+        Exactly the QueryFilter-queryable fields are indexed (ud128/64/32,
+        ledger, code). The reference also indexes amount, pending_id, and
+        timeout (state_machine.zig:207-212) for internal scans this build
+        answers elsewhere: pending expiry via the posted groove,
+        pending_id resolution via the transfer-id index. Index entries are
+        the dominant ingest write-amplification, so unqueryable tags are
+        deliberately not maintained."""
+        from tigerbeetle_tpu.lsm import scan
+
+        with tracer.span("sm.store.query"):
+            tstamp = (
+                np.asarray(ts, dtype=np.uint64)
+                if ts is not None else recs["timestamp"]
             )
-        with tracer.span("sm.store.rows"):
-            acct_keys = np.concatenate([
-                pack_keys(recs["debit_account_id_lo"], recs["debit_account_id_hi"]),
-                pack_keys(recs["credit_account_id_lo"], recs["credit_account_id_hi"]),
-            ])
-            self.account_rows.insert_batch(acct_keys, np.concatenate([rows, rows]))
+            parts = [
+                scan.composite_keys(
+                    scan.TAG_UD128,
+                    scan.fold56(
+                        recs["user_data_128_lo"], recs["user_data_128_hi"]
+                    ),
+                    tstamp,
+                ),
+                scan.composite_keys(
+                    scan.TAG_UD64, scan.fold56(recs["user_data_64"]), tstamp,
+                ),
+                scan.composite_keys(
+                    scan.TAG_UD32, scan.fold56(recs["user_data_32"]), tstamp,
+                ),
+                scan.composite_keys(
+                    scan.TAG_LEDGER, scan.fold56(recs["ledger"]), tstamp,
+                ),
+                scan.composite_keys(
+                    scan.TAG_CODE, scan.fold56(recs["code"]), tstamp,
+                ),
+            ]
+            self.query_rows.insert_unsorted(
+                np.concatenate(parts), np.tile(rows, len(parts)),
+            )
 
     def _store_native(self, recs: np.ndarray, row_base: int) -> bool:
         """C-fused index staging (hostops_build_sorted_kv): builds the
@@ -378,6 +431,7 @@ class StateMachine:
             lambda: self.history.flush_pending(max_blocks),
             self.transfer_index.compact_step,
             self.account_rows.compact_step,
+            self.query_rows.compact_step,
             self.posted.compact_step,
             self.history.compact_step,
         )
@@ -1400,6 +1454,10 @@ class StateMachine:
         slots = self.account_index.lookup_batch(keys)
         found = slots != NOT_FOUND
         s = slots[found].astype(np.int64)
+        return self._accounts_at(s)
+
+    def _accounts_at(self, s: np.ndarray) -> np.ndarray:
+        """Pack wire ACCOUNT records for an array of slots."""
         out = np.zeros(len(s), dtype=types.ACCOUNT_DTYPE)
         if len(s) == 0:
             return out
@@ -1423,6 +1481,194 @@ class StateMachine:
         out["flags"] = self.acc_flags[s]
         out["timestamp"] = self.acc_timestamp[s]
         return out
+
+    def query_transfers(self, f: np.void) -> np.ndarray:
+        """Index-backed equality query over transfers (reference ScanBuilder
+        range scans per index + boolean merge, scan_builder.zig:454,
+        scan_merge.zig:252): each nonzero filter field becomes a
+        composite-key prefix scan over the combined query index, the row
+        sets intersect vectorized, and the gathered rows are re-verified
+        exactly (fold56 collisions over-select, never mis-answer)."""
+        from tigerbeetle_tpu.lsm import scan
+
+        self.flush_deferred()
+        ud128_lo = int(f["user_data_128_lo"])
+        ud128_hi = int(f["user_data_128_hi"])
+        ud64 = int(f["user_data_64"])
+        ud32 = int(f["user_data_32"])
+        ledger = int(f["ledger"])
+        code = int(f["code"])
+        limit = int(f["limit"])
+        flags = int(f["flags"])
+        ts_min_raw, ts_max_raw = int(f["timestamp_min"]), int(f["timestamp_max"])
+        if not Oracle._query_filter_valid(ts_min_raw, ts_max_raw, limit, flags):
+            return np.zeros(0, dtype=types.TRANSFER_DTYPE)
+        ts_min = ts_min_raw if ts_min_raw else 1
+        ts_max = ts_max_raw if ts_max_raw else U64_MAX - 1
+
+        preds = []
+        if ud128_lo or ud128_hi:
+            preds.append((scan.TAG_UD128, ud128_lo, ud128_hi))
+        if ud64:
+            preds.append((scan.TAG_UD64, ud64, 0))
+        if ud32:
+            preds.append((scan.TAG_UD32, ud32, 0))
+        if ledger:
+            preds.append((scan.TAG_LEDGER, ledger, 0))
+        if code:
+            preds.append((scan.TAG_CODE, code, 0))
+
+        def verify(t: np.ndarray) -> np.ndarray:
+            keep = (t["timestamp"] >= np.uint64(ts_min)) & (
+                t["timestamp"] <= np.uint64(ts_max)
+            )
+            if ud128_lo or ud128_hi:
+                keep &= (t["user_data_128_lo"] == np.uint64(ud128_lo)) & (
+                    t["user_data_128_hi"] == np.uint64(ud128_hi)
+                )
+            if ud64:
+                keep &= t["user_data_64"] == np.uint64(ud64)
+            if ud32:
+                keep &= t["user_data_32"] == np.uint32(ud32)
+            if ledger:
+                keep &= t["ledger"] == np.uint32(ledger)
+            if code:
+                keep &= t["code"] == np.uint16(code)
+            return keep
+
+        if not preds:
+            # No equality predicate: bounded walk of the timestamp-ordered
+            # object log (newest-first under REVERSED), stopping at limit.
+            t = self._log_window(ts_min, ts_max, limit, bool(flags & 1))
+            ix = np.nonzero(verify(t))[0]  # row order IS timestamp order
+            if flags & 1:
+                ix = ix[::-1]
+            return t[ix[:limit]]
+
+        # Adaptive selectivity: abandon scans past the cap (their
+        # predicate is re-verified on the gathered rows instead, which is
+        # cheaper than materializing an unselective scan in full).
+        complete = []
+        scanned = []
+        for tag, lo, hi in preds:
+            vals, full = self.query_rows.scan_lo_capped(
+                scan.prefix(tag, lo, hi), ts_min, ts_max
+            )
+            scanned.append((vals, full))
+            if full:
+                complete.append(vals)
+        if complete:
+            rows = scan.intersect_rows(complete)
+        else:
+            # Every predicate is unselective: fall back to the full scan
+            # of the one that accumulated the least before hitting the
+            # cap (best available signal).
+            tag, lo, hi = preds[
+                min(range(len(preds)), key=lambda i: len(scanned[i][0]))
+            ]
+            rows = self.query_rows.scan_lo(
+                scan.prefix(tag, lo, hi), ts_min, ts_max
+            )
+
+        # Limit-aware chunked gather: candidates are timestamp-ordered, so
+        # walk them from the answering end in chunks, verify, and stop as
+        # soon as `limit` rows survive — a limit-100 query gathers ~100
+        # candidates' blocks, not the full candidate set (whose scattered
+        # rows could touch most of the log).
+        reversed_ = bool(flags & 1)
+        chunk = max(256, 4 * limit)
+        parts: list = []
+        got = 0
+        pos = len(rows) if reversed_ else 0
+        while got < limit and (pos > 0 if reversed_ else pos < len(rows)):
+            if reversed_:
+                lo_ix = max(0, pos - chunk)
+                sel_rows = rows[lo_ix:pos]
+                pos = lo_ix
+            else:
+                sel_rows = rows[pos : pos + chunk]
+                pos += chunk
+            t = self.transfer_log.gather(sel_rows)
+            hit = t[verify(t)]
+            if len(hit):
+                parts.append(hit)
+                got += len(hit)
+        if not parts:
+            return np.zeros(0, dtype=types.TRANSFER_DTYPE)
+        if reversed_:
+            out = np.concatenate(parts[::-1])
+            return out[::-1][:limit]
+        out = np.concatenate(parts)
+        return out[:limit]
+
+    def _log_window(
+        self, ts_min: int, ts_max: int, limit: int, reversed_: bool
+    ) -> np.ndarray:
+        """≤limit log records inside [ts_min, ts_max], walking whole blocks
+        lazily from the matching end (timestamps are monotone with row) —
+        a limit-10 newest-first query touches one block, never the log."""
+        log = self.transfer_log
+        count = log.count
+        if count == 0:
+            return np.zeros(0, dtype=types.TRANSFER_DTYPE)
+        rpb = log.records_per_block
+        out: list = []
+        got = 0
+        blocks = range((count - 1) // rpb, -1, -1) if reversed_ else range(
+            0, (count - 1) // rpb + 1
+        )
+        for b in blocks:
+            base = b * rpb
+            for _base2, recs in log.scan_range(base, min(base + rpb, count)):
+                sel = recs[
+                    (recs["timestamp"] >= np.uint64(ts_min))
+                    & (recs["timestamp"] <= np.uint64(ts_max))
+                ]
+                if len(sel):
+                    out.append(sel)
+                    got += len(sel)
+            if got >= limit:
+                break
+        if not out:
+            return np.zeros(0, dtype=types.TRANSFER_DTYPE)
+        # Ascending row order either way (the caller applies limit and
+        # direction); a superset is fine — it only re-verifies and trims.
+        return np.concatenate(out[::-1] if reversed_ else out)
+
+    def query_accounts(self, f: np.void) -> np.ndarray:
+        """Equality query over accounts. The accounts table is bounded
+        (accounts_max) and RAM/device-resident, so the TPU-first answer is
+        a vectorized column filter — no index trees needed (the reference
+        builds 5 LSM index trees because its account table is
+        disk-resident; ours is the batch-parallel axis)."""
+        self.flush_deferred()
+        limit = int(f["limit"])
+        flags = int(f["flags"])
+        ts_min_raw, ts_max_raw = int(f["timestamp_min"]), int(f["timestamp_max"])
+        if not Oracle._query_filter_valid(ts_min_raw, ts_max_raw, limit, flags):
+            return np.zeros(0, dtype=types.ACCOUNT_DTYPE)
+        ts_min = ts_min_raw if ts_min_raw else 1
+        ts_max = ts_max_raw if ts_max_raw else U64_MAX - 1
+        n = self.account_count
+        keep = (self.acc_timestamp[:n] >= np.uint64(ts_min)) & (
+            self.acc_timestamp[:n] <= np.uint64(ts_max)
+        )
+        if int(f["user_data_128_lo"]) or int(f["user_data_128_hi"]):
+            keep &= (
+                self.acc_user_data_128_lo[:n] == f["user_data_128_lo"]
+            ) & (self.acc_user_data_128_hi[:n] == f["user_data_128_hi"])
+        if int(f["user_data_64"]):
+            keep &= self.acc_user_data_64[:n] == f["user_data_64"]
+        if int(f["user_data_32"]):
+            keep &= self.acc_user_data_32[:n] == f["user_data_32"]
+        if int(f["ledger"]):
+            keep &= self.acc_ledger[:n] == f["ledger"]
+        if int(f["code"]):
+            keep &= self.acc_code[:n] == f["code"]
+        s = np.nonzero(keep)[0]  # slot order IS creation-timestamp order
+        if flags & 1:
+            s = s[::-1]
+        return self._accounts_at(s[:limit].astype(np.int64))
 
     def lookup_transfers(self, ids_lo: np.ndarray, ids_hi: np.ndarray) -> np.ndarray:
         self.flush_deferred()

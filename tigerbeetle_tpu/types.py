@@ -81,6 +81,26 @@ ACCOUNT_FILTER_DTYPE = np.dtype(
 )
 assert ACCOUNT_FILTER_DTYPE.itemsize == 64
 
+# QueryFilter (upstream TigerBeetle QueryFilter shape; this reference
+# snapshot predates the query ops, so the layout is forward-modeled on
+# the released wire struct): zero fields are ignored, nonzero fields are
+# ANDed equality predicates; flags bit 0 = reversed (newest first).
+QUERY_FILTER_DTYPE = np.dtype(
+    [
+        ("user_data_128_lo", "<u8"), ("user_data_128_hi", "<u8"),
+        ("user_data_64", "<u8"),
+        ("user_data_32", "<u4"),
+        ("ledger", "<u4"),
+        ("code", "<u2"),
+        ("reserved", "V6"),
+        ("timestamp_min", "<u8"),
+        ("timestamp_max", "<u8"),
+        ("limit", "<u4"),
+        ("flags", "<u4"),
+    ]
+)
+assert QUERY_FILTER_DTYPE.itemsize == 64
+
 # (index: u32, result: u32) — reference tigerbeetle.zig:247-266.
 EVENT_RESULT_DTYPE = np.dtype([("index", "<u4"), ("result", "<u4")])
 assert EVENT_RESULT_DTYPE.itemsize == 8

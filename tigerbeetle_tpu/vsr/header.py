@@ -80,6 +80,10 @@ class Operation:
     LOOKUP_TRANSFERS = 131
     GET_ACCOUNT_TRANSFERS = 132
     GET_ACCOUNT_HISTORY = 133
+    # Index-backed equality queries (upstream TigerBeetle query_accounts /
+    # query_transfers numbering; body = one QUERY_FILTER_DTYPE record).
+    QUERY_ACCOUNTS = 134
+    QUERY_TRANSFERS = 135
 
     NAMES_BY_STR = {
         "create_accounts": 128,

@@ -75,6 +75,8 @@ def _event_dtype(operation: int) -> np.dtype:
         return types.TRANSFER_DTYPE
     if operation in (Operation.LOOKUP_ACCOUNTS, Operation.LOOKUP_TRANSFERS):
         return types.ID_DTYPE
+    if operation in (Operation.QUERY_ACCOUNTS, Operation.QUERY_TRANSFERS):
+        return types.QUERY_FILTER_DTYPE
     return types.ACCOUNT_FILTER_DTYPE
 
 
@@ -230,6 +232,9 @@ class Replica:
         # The _finish_commit (store/compaction) of an already-committed op
         # faulted: it must complete after repair BEFORE any further op.
         self._finish_pending = False
+        # A checkpoint's trailer write faulted mid-drain (corrupt
+        # compaction input found while draining): retried after repair.
+        self._checkpoint_pending = False
 
         # Injected time + cluster clock (reference clock.zig via ping/pong
         # offset samples; DeterministicTime keeps simulations reproducible).
@@ -380,21 +385,23 @@ class Replica:
         if resume_block_sync is None:
             # Re-execute contiguous committed prepares beyond the checkpoint.
             replay_to = min(self.commit_max, self.op)
+            faulted = False
             for op in range(st.op_checkpoint + 1, replay_to + 1):
                 msg = self.journal.read_prepare(op)
                 if msg is None:
                     break
-                self._execute(msg, replay=True)
-                self.commit_min = op
-            if self.replica_count == 1:
+                if not self._replay_exec(msg, op):
+                    faulted = True
+                    break
+            if self.replica_count == 1 and not faulted:
                 # Single replica: every durable prepare is committable.
                 for op in range(self.commit_min + 1, self.op + 1):
                     msg = self.journal.read_prepare(op)
                     if msg is None:
                         self.op = op - 1  # torn tail — truncate
                         break
-                    self._execute(msg, replay=True)
-                    self.commit_min = op
+                    if not self._replay_exec(msg, op):
+                        break
                 self.commit_max = max(self.commit_max, self.commit_min)
         if self.replica_count == 1:
             self.status = STATUS_NORMAL
@@ -411,6 +418,35 @@ class Replica:
         # the same way a new primary's inherited suffix does.
         self._eviction_floor = self.op
         self.on_event("open", self)
+
+    def _replay_exec(self, msg: Message, op: int) -> bool:
+        """Replay one committed prepare at boot. False when a corrupt grid
+        block (latent sector error in an LSM block an op reads lazily)
+        stopped it: grid repair is initiated — the retry ticks push the
+        request once connections form; a solo replica fail-stops inside
+        _begin_grid_repair. Execute-phase faults leave the op uncommitted
+        (cleanly re-executed after repair); finish-phase faults mark
+        _finish_pending so the beat RESUMES, never re-runs."""
+        try:
+            self._execute(msg)
+        except GridReadFault as fault:
+            log.warning(
+                "replica %d: corrupt grid block at op %d during boot "
+                "replay — repairing from a peer after joining",
+                self.replica, op,
+            )
+            tracer.count("mark.open_replay_fault")
+            self._begin_grid_repair(fault)
+            return False
+        self.commit_min = op
+        try:
+            self._finish_commit()
+        except GridReadFault as fault:
+            tracer.count("mark.open_replay_fault")
+            self._finish_pending = True
+            self._begin_grid_repair(fault)
+            return False
+        return True
 
     # ------------------------------------------------------------------
     # ticks / timeouts
@@ -623,6 +659,9 @@ class Replica:
             # Exactly one filter record — a zero-event body would otherwise
             # fault every replica at commit (client-triggerable poison pill).
             if len(body) != types.ACCOUNT_FILTER_DTYPE.itemsize:
+                return False
+        elif operation in (Operation.QUERY_ACCOUNTS, Operation.QUERY_TRANSFERS):
+            if len(body) != types.QUERY_FILTER_DTYPE.itemsize:
                 return False
         elif operation >= 128:
             ev_size = _event_dtype(operation).itemsize
@@ -910,7 +949,11 @@ class Replica:
                 # Earlier ops (from before a view change) must commit through
                 # the journal first; _commit_journal re-checks the pipeline.
                 break
-            if self._grid_repair is not None or self._finish_pending:
+            if (
+                self._grid_repair is not None
+                or self._finish_pending
+                or self._checkpoint_pending
+            ):
                 break  # a block repair is in flight: commits are gated
             self.pipeline.pop(0)
             self.commit_max = max(self.commit_max, op)
@@ -939,7 +982,8 @@ class Replica:
                 self._finish_pending = True
                 self._begin_grid_repair(fault)
                 break
-            self._maybe_checkpoint()
+            if not self._checkpoint_guarded():
+                break
         while self.request_queue and len(self.pipeline) < self.config.pipeline_max:
             self._primary_prepare(self.request_queue.pop(0))
 
@@ -1011,7 +1055,11 @@ class Replica:
             # could read a grid block that has not arrived yet. Commits
             # resume from _finish_block_sync.
             return
-        if self._grid_repair is not None or self._finish_pending:
+        if (
+            self._grid_repair is not None
+            or self._finish_pending
+            or self._checkpoint_pending
+        ):
             return  # a block repair is in flight: commits are gated
         while self.commit_min < self.commit_max:
             op = self.commit_min + 1
@@ -1032,7 +1080,8 @@ class Replica:
                 self._finish_pending = True
                 self._begin_grid_repair(fault)
                 break
-            self._maybe_checkpoint()
+            if not self._checkpoint_guarded():
+                break
         if self.is_primary and self.pipeline:
             self._check_pipeline_quorum()
 
@@ -1651,7 +1700,12 @@ class Replica:
                 self._finish_pending = True
                 self._begin_grid_repair(fault)
                 return
-            self._maybe_checkpoint()
+        # Retry (or perform) any due checkpoint — _maybe_checkpoint no-ops
+        # away from interval boundaries, so one guarded call covers both
+        # the faulted-checkpoint retry and the just-finished op's turn.
+        self._checkpoint_pending = False
+        if not self._checkpoint_guarded():
+            return
         # Resume the gated commit stream. A primary with a requeued
         # pipeline head MUST resume through the pipeline (committing the
         # op via the journal path would discard its client reply and
@@ -2043,6 +2097,20 @@ class Replica:
             self._finish_commit()
         return reply
 
+    def _checkpoint_guarded(self) -> bool:
+        """_maybe_checkpoint with grid-repair handling: the trailer write
+        drains compactions, whose reads can hit a corrupt block. Returns
+        False when a repair was started (commits gate; the checkpoint
+        retries after repair — its content is deterministic, and the
+        aborted drain job restarts identically)."""
+        try:
+            self._maybe_checkpoint()
+            return True
+        except GridReadFault as fault:
+            self._checkpoint_pending = True
+            self._begin_grid_repair(fault)
+            return False
+
     def _finish_commit(self) -> None:
         """Deferred tail of the per-op apply sequence: the state machine's
         deferred object store, then the compaction beat. Runs AFTER the
@@ -2092,6 +2160,14 @@ class Replica:
             elif operation == Operation.GET_ACCOUNT_HISTORY:
                 results = (
                     self._get_account_history(events[0]).tobytes() if len(events) else b""
+                )
+            elif operation == Operation.QUERY_ACCOUNTS:
+                results = (
+                    sm.query_accounts(events[0]).tobytes() if len(events) else b""
+                )
+            elif operation == Operation.QUERY_TRANSFERS:
+                results = (
+                    sm.query_transfers(events[0]).tobytes() if len(events) else b""
                 )
             else:
                 results = b""

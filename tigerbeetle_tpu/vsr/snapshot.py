@@ -103,6 +103,7 @@ def content_trees(sm):
     return (
         ("ti", sm.transfer_index),
         ("ai", sm.account_rows),
+        ("qi", sm.query_rows),
         ("po", sm.posted.index),
         ("hi", sm.history.rows),
     )
@@ -252,7 +253,7 @@ def block_checksums(blob: bytes) -> dict:
     }
 
 
-_TREE_PREFIXES = ("ti", "ai", "po", "hi")
+_TREE_PREFIXES = ("ti", "ai", "qi", "po", "hi")
 _LOG_PREFIXES = ("log", "hlog")
 
 _LOCAL_REQUIRED = (
