@@ -303,6 +303,56 @@ class TestReplicated:
         with _pytest.raises(AssertionError, match="storage divergence"):
             cl.check_storage_convergence()
 
+    def test_storage_checker_catches_lagging_divergence(self):
+        """A replica standing one checkpoint BEHIND with divergent bytes
+        is compared against the recorded history of that checkpoint — a
+        perpetually-lagging diverged replica must not be invisible
+        (VERDICT r4 weak #6)."""
+        import pytest as _pytest
+
+        from tigerbeetle_tpu.lsm.store import pack_keys
+
+        cl = Cluster(replica_count=3, seed=29)
+        c = setup_client(cl)
+        do_request(cl, c, Operation.CREATE_ACCOUNTS, account_batch([1, 2]))
+        # Diverge replica 2's durable index BEFORE the first checkpoint.
+        rogue = cl.replicas[2]
+        rogue.state_machine.account_rows.insert_batch(
+            pack_keys(np.array([0xBAD], np.uint64), np.array([0], np.uint64)),
+            np.array([3], np.uint32),
+        )
+        # Cross checkpoint 1 (interval 16) on everyone.
+        for i in range(20):
+            do_request(cl, c, Operation.CREATE_TRANSFERS, transfer_batch([
+                dict(id=1 + i, debit_account_id=1, credit_account_id=2,
+                     amount=1, ledger=1, code=1),
+            ]))
+        target = max(r.commit_min for r in cl.replicas)
+        cl.run_until(lambda: all(
+            r.superblock.state.op_checkpoint > 0 and r.commit_min >= target
+            for r in cl.replicas
+        ))
+        ck1 = cl.replicas[2].superblock.state.op_checkpoint
+        # Freeze the rogue at checkpoint 1 (crash; no restart) while the
+        # others advance past checkpoint 2.
+        cl.storages[2].sync()
+        cl.crash_replica(2)
+        for i in range(20):
+            do_request(cl, c, Operation.CREATE_TRANSFERS, transfer_batch([
+                dict(id=100 + i, debit_account_id=1, credit_account_id=2,
+                     amount=1, ledger=1, code=1),
+            ]))
+        cl.run_until(lambda: all(
+            r.superblock.state.op_checkpoint > ck1
+            for r in cl.replicas if r is not None
+        ))
+        # Revive the rogue WITHOUT letting it catch up: it stands at the
+        # older checkpoint with divergent bytes.
+        cl.restart_replica(2)
+        assert cl.replicas[2].superblock.state.op_checkpoint == ck1
+        with _pytest.raises(AssertionError, match="LAGGING"):
+            cl.check_storage_convergence()
+
     def test_determinism_same_seed(self):
         def run(seed):
             cl = Cluster(replica_count=3, seed=seed, loss=0.02)

@@ -1,0 +1,19 @@
+"""Smoke the component fuzzer registry (reference fuzz_tests.zig:24-40):
+every registered fuzzer runs a couple of seeds at reduced iteration
+counts on each CI pass — full sweeps run via
+`python -m tigerbeetle_tpu.fuzz <name> --seeds N`."""
+
+import pytest
+
+from tigerbeetle_tpu import fuzz
+
+
+@pytest.mark.parametrize("name", sorted(fuzz.REGISTRY))
+def test_fuzzer_smoke(name):
+    for seed in (0, 1):
+        fuzz.REGISTRY[name](seed, max(50, fuzz.DEFAULT_ITERS[name] // 4))
+
+
+def test_registry_cli():
+    assert fuzz.main(["--list"]) == 0
+    assert fuzz.main(["ewah", "--seed", "3", "--iters", "20"]) == 0

@@ -196,6 +196,21 @@ class TestQueryTransfers:
         assert len(sm.query_transfers(_filter_rec(flags=0x8))) == 0
 
 
+class TestScanMerges:
+    def test_union_and_intersection(self):
+        a = np.array([1, 3, 5, 9], dtype=np.uint32)
+        b = np.array([3, 4, 5, 10], dtype=np.uint32)
+        c = np.array([5, 9, 10], dtype=np.uint32)
+        assert scan.intersect_rows([a, b]).tolist() == [3, 5]
+        assert scan.intersect_rows([a, b, c]).tolist() == [5]
+        assert scan.union_rows([a, b]).tolist() == [1, 3, 4, 5, 9, 10]
+        assert scan.intersect_rows([]).tolist() == []
+        assert scan.union_rows([]).tolist() == []
+        assert scan.intersect_rows(
+            [a, np.zeros(0, dtype=np.uint32)]
+        ).tolist() == []
+
+
 class TestQueryAccounts:
     def test_property_random_filters(self):
         sm, orc, _pools = _build_store(3, n_batches=1)

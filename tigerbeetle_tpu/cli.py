@@ -240,15 +240,19 @@ def _repl_execute(client, op: str, rest: str, types) -> None:
                 "credits_posted": types.u128_of(r, "credits_posted"),
             })
     elif op in ("query_accounts", "query_transfers"):
-        kw = {
-            k: objs[0].get(k, 0)
-            for k in (
-                "user_data_128", "user_data_64", "user_data_32",
-                "ledger", "code", "timestamp_min", "timestamp_max",
-            )
-        } if objs else {}
-        if objs and "limit" in objs[0]:
-            kw["limit"] = objs[0]["limit"]
+        allowed = (
+            "user_data_128", "user_data_64", "user_data_32",
+            "ledger", "code", "timestamp_min", "timestamp_max",
+            "limit", "flags",
+        )
+        kw = dict(objs[0]) if objs else {}
+        unknown = set(kw) - set(allowed)
+        if unknown:
+            # A typo'd filter key silently matching everything would be a
+            # dangerous way to learn the field names.
+            print(f"unknown filter keys: {sorted(unknown)}; "
+                  f"allowed: {', '.join(allowed)}")
+            return
         recs = getattr(client, op)(**kw)
         print(f"{len(recs)} rows")
         for r in recs[:10]:
