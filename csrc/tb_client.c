@@ -118,6 +118,39 @@ static int recv_all(int fd, uint8_t *p, size_t n) {
     return 0;
 }
 
+int tbc_demux_results(
+    uint8_t *results, uint32_t n_results,
+    const uint32_t *batch_lens, uint32_t n_batches,
+    uint32_t *out_offsets, uint32_t *out_counts
+) {
+    uint64_t total = 0;
+    for (uint32_t b = 0; b < n_batches; b++) total += batch_lens[b];
+    uint32_t row = 0, prev_index = 0;
+    uint64_t base = 0;
+    for (uint32_t b = 0; b < n_batches; b++) {
+        out_offsets[b] = row;
+        out_counts[b] = 0;
+        uint64_t end = base + batch_lens[b];
+        while (row < n_results) {
+            uint32_t index, result;
+            memcpy(&index, results + 8u * row, 4);
+            memcpy(&result, results + 8u * row + 4, 4);
+            if (index >= total) return TBC_ERR_PROTOCOL;
+            /* Strictly ascending: duplicate indices (two results for one
+             * event) are a protocol violation too. */
+            if (row > 0 && index <= prev_index) return TBC_ERR_PROTOCOL;
+            if (index >= end) break; /* belongs to a later batch */
+            prev_index = index;
+            index -= (uint32_t)base; /* rebase into the batch */
+            memcpy(results + 8u * row, &index, 4);
+            out_counts[b]++;
+            row++;
+        }
+        base = end;
+    }
+    return row == n_results ? 0 : TBC_ERR_PROTOCOL;
+}
+
 static void rand_bytes(uint8_t *p, size_t n) {
     /* Client ids must be unique across threads AND processes: two handles
      * sharing an id share one VSR session (crossed replies). Use the OS

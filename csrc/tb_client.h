@@ -67,6 +67,20 @@ int64_t tbc_lookup_transfers(
     tbc_client *c, const uint8_t *ids, uint32_t count,
     uint8_t *transfers_out, uint32_t transfers_max);
 
+/* Multi-batch demuxer (the reference state_machine Demuxer's role):
+ * after submitting N logical batches CONCATENATED as one
+ * tbc_create_accounts/transfers call (one request -> one prepare -> one
+ * consensus round), split the (index u32, result u32) rows back into
+ * per-batch spans. batch_lens[n_batches] are the logical batch event
+ * counts in submission order. Rows are index-ascending and are rebased
+ * IN PLACE into their batch; out_offsets[b]/out_counts[b] describe batch
+ * b's contiguous span within `results` afterward. Returns 0, or
+ * TBC_ERR_PROTOCOL if rows are out of range or not ascending. */
+int tbc_demux_results(
+    uint8_t *results, uint32_t n_results,
+    const uint32_t *batch_lens, uint32_t n_batches,
+    uint32_t *out_offsets, uint32_t *out_counts);
+
 #ifdef __cplusplus
 }
 #endif

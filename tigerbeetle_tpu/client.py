@@ -540,6 +540,96 @@ class AsyncClient:
         )
         return np.frombuffer(bytearray(reply.body), dtype=types.EVENT_RESULT_DTYPE)
 
+    # --- multi-batch coalescing + demux --------------------------------
+    # (reference client.zig:45 Batch + state_machine.zig:126-165 Demuxer:
+    # multiple logical batches ride ONE request/prepare; results split by
+    # event-index ranges.)
+
+    @staticmethod
+    def plan_coalesce(batches, batch_max: int, linked_flag: int = 0x1):
+        """Group logical batches into request-sized groups (lists of
+        batch indices). A batch whose LAST event leaves a linked chain
+        open is sent alone — coalescing it would splice the open chain
+        into the next batch's first event, changing its semantics (the
+        standalone request errors it as linked_event_chain_open, and so
+        must the coalesced execution)."""
+        groups: list = []
+        cur: list = []
+        cur_n = 0
+        for i, ev in enumerate(batches):
+            n = len(ev)
+            assert n <= batch_max, "logical batch exceeds batch_max"
+            open_chain = n > 0 and bool(ev["flags"][-1] & linked_flag)
+            if open_chain:
+                if cur:
+                    groups.append(cur)
+                    cur, cur_n = [], 0
+                groups.append([i])
+                continue
+            if cur_n + n > batch_max:
+                groups.append(cur)
+                cur, cur_n = [], 0
+            cur.append(i)
+            cur_n += n
+        if cur:
+            groups.append(cur)
+        return groups
+
+    @staticmethod
+    def demux_results(results: np.ndarray, lens) -> list:
+        """Split one request's EVENT_RESULT rows into per-batch arrays,
+        re-basing each row's index into its batch (the reference Demuxer,
+        state_machine.zig:126-165). The protocol invariant — strictly
+        ascending indices below the request's event count — is ENFORCED:
+        a corrupt or mismatched reply raises instead of silently dropping
+        rows (which would make a failed event look ok). Splitting is one
+        searchsorted over the cumulative offsets."""
+        total = int(sum(lens))
+        idx = results["index"]
+        if len(idx):
+            if int(idx[-1]) >= total or (
+                len(idx) > 1 and not bool(np.all(idx[1:] > idx[:-1]))
+            ):
+                raise ClientError(
+                    "demux: result indices out of range or not strictly "
+                    "ascending — reply does not match the submitted batches"
+                )
+        offsets = np.cumsum([0] + list(lens), dtype=np.int64)
+        bounds = np.searchsorted(idx, offsets)
+        out = []
+        for b in range(len(lens)):
+            part = results[bounds[b] : bounds[b + 1]].copy()
+            part["index"] -= np.uint32(offsets[b])
+            out.append(part)
+        return out
+
+    async def submit_many(self, operation: int, batches) -> list:
+        """Submit N logical batches, coalescing them into as few
+        requests (→ prepares → fsyncs → consensus rounds) as batch_max
+        allows; returns per-batch result arrays byte-equal to N separate
+        requests. Groups are submitted SEQUENTIALLY: cross-batch
+        dependencies (a later batch re-using an earlier batch's id) must
+        observe the same commit order as N separate requests — the
+        throughput win is the coalescing itself, not group concurrency.
+        Small-batch workloads stop paying full consensus cost per batch
+        (reference batch_get/batch_submit)."""
+        from tigerbeetle_tpu.constants import BATCH_MAX
+
+        batches = [np.ascontiguousarray(b) for b in batches]
+        groups = self.plan_coalesce(batches, batch_max=BATCH_MAX)
+
+        out: list = [None] * len(batches)
+        for ix in groups:
+            bodies = [batches[i] for i in ix]
+            joined = np.concatenate(bodies) if len(bodies) > 1 else bodies[0]
+            reply = await self.submit(operation, joined)
+            res = np.frombuffer(
+                bytearray(reply.body), dtype=types.EVENT_RESULT_DTYPE
+            )
+            for i, part in zip(ix, self.demux_results(res, [len(b) for b in bodies])):
+                out[i] = part
+        return out
+
     async def create_accounts(self, accounts: np.ndarray) -> np.ndarray:
         reply = await self.submit(
             Operation.CREATE_ACCOUNTS, np.ascontiguousarray(accounts)
