@@ -1,0 +1,75 @@
+/* cpp_sample.cpp — the C++ binding's sample flow (the role of the
+ * reference's per-language sample apps, run against a live server by
+ * clients CI — src/scripts/ci.zig): create accounts, post transfers
+ * (incl. a failing event and a coalesced multi-batch submission), look
+ * everything back up, and assert the balances.
+ *
+ * Build (tests/test_cpp_client.py does this):
+ *   g++ -std=c++17 -O2 -maes -mssse3 cpp_sample.cpp tb_client.c -o cpp_sample
+ * Run: ./cpp_sample <host> <port>
+ */
+
+#include <cassert>
+#include <cstdio>
+#include <cstdlib>
+
+#include "tb_client.hpp"
+
+using namespace tigerbeetle;
+
+int main(int argc, char **argv) {
+    if (argc != 3) {
+        std::fprintf(stderr, "usage: %s <host> <port>\n", argv[0]);
+        return 2;
+    }
+    const char *host = argv[1];
+    const auto port = static_cast<std::uint16_t>(std::atoi(argv[2]));
+
+    try {
+        Client client(host, port);
+
+        Account a1{}, a2{};
+        a1.id_lo = 1, a1.ledger = 1, a1.code = 10;
+        a2.id_lo = 2, a2.ledger = 1, a2.code = 10;
+        auto acc_res = client.create_accounts({a1, a2});
+        assert(acc_res.empty() && "accounts must create cleanly");
+
+        Transfer ok{}, bad{};
+        ok.id_lo = 1, ok.debit_account_id_lo = 1, ok.credit_account_id_lo = 2;
+        ok.amount_lo = 42, ok.ledger = 1, ok.code = 7;
+        bad = ok;
+        bad.id_lo = 2, bad.debit_account_id_lo = 99;  // unknown account
+        auto tr_res = client.create_transfers({ok, bad});
+        assert(tr_res.size() == 1 && tr_res[0].index == 1 &&
+               "exactly the bad event fails");
+
+        // Coalesced multi-batch: 3 logical batches, one request/prepare.
+        Transfer t3 = ok, t4 = ok, t5 = ok;
+        t3.id_lo = 3, t3.amount_lo = 8;
+        t4.id_lo = 4, t4.amount_lo = 50, t4.debit_account_id_lo = 99;  // fails
+        t5.id_lo = 5, t5.amount_lo = 10;
+        auto parts = client.create_transfers_batched({{t3}, {t4}, {t5}});
+        assert(parts.size() == 3);
+        assert(parts[0].empty() && parts[2].empty());
+        assert(parts[1].size() == 1 && parts[1][0].index == 0 &&
+               "failure demuxed into its batch, index rebased");
+
+        auto accounts = client.lookup_accounts({{1, 0}, {2, 0}});
+        assert(accounts.size() == 2);
+        assert(accounts[0].debits_posted_lo == 60);   // 42 + 8 + 10
+        assert(accounts[1].credits_posted_lo == 60);
+
+        auto transfers = client.lookup_transfers({{1, 0}, {3, 0}, {5, 0}});
+        assert(transfers.size() == 3);
+        assert(transfers[0].amount_lo == 42);
+        assert(transfers[1].amount_lo == 8);
+        assert(transfers[2].amount_lo == 10);
+
+        std::printf("cpp_sample OK: accounts, transfers, coalesced "
+                    "batches, lookups all verified\n");
+        return 0;
+    } catch (const Error &e) {
+        std::fprintf(stderr, "cpp_sample FAILED: %s\n", e.what());
+        return 1;
+    }
+}

@@ -1,0 +1,237 @@
+/* tb_client.hpp — C++ binding over the C ABI (tb_client.h).
+ *
+ * The role of the reference's language bindings (src/clients/go, java,
+ * dotnet, node — each a typed wrapper over clients/c/tb_client.zig's C
+ * ABI): typed wire structs with layout asserts, RAII connection
+ * lifetime, exceptions for transport errors, std::vector results. This
+ * is the binding a C++ service embeds; tests/test_cpp_client.py builds
+ * and runs the sample app (cpp_sample.cpp) against a live server in CI,
+ * which is what proves the ABI from a foreign runtime.
+ *
+ * Header-only; link against libtbclient.so (or compile tb_client.c into
+ * the target).
+ */
+
+#ifndef TB_CLIENT_HPP
+#define TB_CLIENT_HPP
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "tb_client.h"
+
+namespace tigerbeetle {
+
+/* Wire structs: byte-identical to the Python ACCOUNT_DTYPE /
+ * TRANSFER_DTYPE (128 B) and EVENT_RESULT_DTYPE (8 B). u128 fields are
+ * lo/hi u64 pairs, little-endian hosts assumed (x86/ARM LE). */
+
+struct alignas(8) Account {
+    std::uint64_t id_lo{}, id_hi{};
+    std::uint64_t debits_pending_lo{}, debits_pending_hi{};
+    std::uint64_t debits_posted_lo{}, debits_posted_hi{};
+    std::uint64_t credits_pending_lo{}, credits_pending_hi{};
+    std::uint64_t credits_posted_lo{}, credits_posted_hi{};
+    std::uint64_t user_data_128_lo{}, user_data_128_hi{};
+    std::uint64_t user_data_64{};
+    std::uint32_t user_data_32{};
+    std::uint32_t reserved{};
+    std::uint32_t ledger{};
+    std::uint16_t code{};
+    std::uint16_t flags{};
+    std::uint64_t timestamp{};
+};
+static_assert(sizeof(Account) == 128, "Account wire layout");
+
+struct alignas(8) Transfer {
+    std::uint64_t id_lo{}, id_hi{};
+    std::uint64_t debit_account_id_lo{}, debit_account_id_hi{};
+    std::uint64_t credit_account_id_lo{}, credit_account_id_hi{};
+    std::uint64_t amount_lo{}, amount_hi{};
+    std::uint64_t pending_id_lo{}, pending_id_hi{};
+    std::uint64_t user_data_128_lo{}, user_data_128_hi{};
+    std::uint64_t user_data_64{};
+    std::uint32_t user_data_32{};
+    std::uint32_t timeout{};
+    std::uint32_t ledger{};
+    std::uint16_t code{};
+    std::uint16_t flags{};
+    std::uint64_t timestamp{};
+};
+static_assert(sizeof(Transfer) == 128, "Transfer wire layout");
+static_assert(offsetof(Account, ledger) == 112 && offsetof(Account, code) == 116
+                  && offsetof(Account, flags) == 118
+                  && offsetof(Account, timestamp) == 120,
+              "Account tail layout");
+static_assert(offsetof(Transfer, timeout) == 108
+                  && offsetof(Transfer, ledger) == 112
+                  && offsetof(Transfer, code) == 116
+                  && offsetof(Transfer, timestamp) == 120,
+              "Transfer tail layout");
+
+struct EventResult {
+    std::uint32_t index{};
+    std::uint32_t result{};
+};
+static_assert(sizeof(EventResult) == 8, "EventResult wire layout");
+
+struct U128 {
+    std::uint64_t lo{}, hi{};
+};
+
+class Error : public std::runtime_error {
+  public:
+    Error(const std::string &what, int code)
+        : std::runtime_error(what + " (tbc error " + std::to_string(code) + ")"),
+          code_(code) {}
+    int code() const { return code_; }
+
+  private:
+    int code_;
+};
+
+class Client {
+  public:
+    Client(const std::string &host, std::uint16_t port,
+           std::uint64_t cluster = 0, std::uint32_t timeout_ms = 5000)
+        : c_(tbc_connect(host.c_str(), port, cluster, timeout_ms)) {
+        if (c_ == nullptr)
+            throw Error("connect/register failed to " + host, TBC_ERR_CONNECT);
+    }
+    ~Client() {
+        if (c_ != nullptr) tbc_close(c_);
+    }
+    Client(const Client &) = delete;
+    Client &operator=(const Client &) = delete;
+    Client(Client &&other) noexcept : c_(other.c_) { other.c_ = nullptr; }
+    Client &operator=(Client &&other) noexcept {
+        if (this != &other) {
+            if (c_ != nullptr) tbc_close(c_);
+            c_ = other.c_;
+            other.c_ = nullptr;
+        }
+        return *this;
+    }
+
+    std::vector<EventResult> create_accounts(const std::vector<Account> &accounts) {
+        return results_call_(tbc_create_accounts,
+                             reinterpret_cast<const std::uint8_t *>(accounts.data()),
+                             accounts.size());
+    }
+
+    std::vector<EventResult> create_transfers(const std::vector<Transfer> &transfers) {
+        return results_call_(tbc_create_transfers,
+                             reinterpret_cast<const std::uint8_t *>(transfers.data()),
+                             transfers.size());
+    }
+
+    std::vector<Account> lookup_accounts(const std::vector<U128> &ids) {
+        return lookup_call_<Account>(tbc_lookup_accounts, ids);
+    }
+
+    std::vector<Transfer> lookup_transfers(const std::vector<U128> &ids) {
+        return lookup_call_<Transfer>(tbc_lookup_transfers, ids);
+    }
+
+    /* Multi-batch submission: coalesce the batches into as few requests
+     * as batch_max allows (each request = one prepare / consensus round
+     * server-side), then split the results per batch with indices
+     * rebased (tbc_demux_results). Grouping follows the Python client's
+     * plan_coalesce rules: a batch whose LAST transfer leaves a linked
+     * chain open ships ALONE (splicing it into the next batch's first
+     * event would close the chain across the boundary and change both
+     * batches' semantics), and groups never exceed batch_max events.
+     * Groups submit sequentially so cross-batch dependencies observe
+     * the same commit order as separate requests. */
+    static constexpr std::size_t batch_max = 8190;  /* (1 MiB - 256)/128 */
+    static constexpr std::uint16_t flag_linked = 0x1;
+
+    std::vector<std::vector<EventResult>> create_transfers_batched(
+        const std::vector<std::vector<Transfer>> &batches) {
+        std::vector<std::vector<std::size_t>> groups;
+        std::vector<std::size_t> cur;
+        std::size_t cur_n = 0;
+        for (std::size_t i = 0; i < batches.size(); i++) {
+            const auto &b = batches[i];
+            if (b.size() > batch_max)
+                throw Error("logical batch exceeds batch_max",
+                            TBC_ERR_TOO_LARGE);
+            bool open_chain =
+                !b.empty() && (b.back().flags & flag_linked) != 0;
+            if (open_chain) {
+                if (!cur.empty()) groups.push_back(std::move(cur));
+                cur.clear(), cur_n = 0;
+                groups.push_back({i});
+                continue;
+            }
+            if (cur_n + b.size() > batch_max) {
+                groups.push_back(std::move(cur));
+                cur.clear(), cur_n = 0;
+            }
+            cur.push_back(i);
+            cur_n += b.size();
+        }
+        if (!cur.empty()) groups.push_back(std::move(cur));
+
+        std::vector<std::vector<EventResult>> out(batches.size());
+        for (const auto &group : groups) {
+            std::vector<Transfer> joined;
+            std::vector<std::uint32_t> lens;
+            for (std::size_t i : group) {
+                joined.insert(joined.end(), batches[i].begin(),
+                              batches[i].end());
+                lens.push_back(
+                    static_cast<std::uint32_t>(batches[i].size()));
+            }
+            auto rows = create_transfers(joined);
+            std::vector<std::uint32_t> offsets(group.size()),
+                counts(group.size());
+            int rc = tbc_demux_results(
+                reinterpret_cast<std::uint8_t *>(rows.data()),
+                static_cast<std::uint32_t>(rows.size()), lens.data(),
+                static_cast<std::uint32_t>(lens.size()), offsets.data(),
+                counts.data());
+            if (rc != 0) throw Error("demux failed", rc);
+            for (std::size_t g = 0; g < group.size(); g++)
+                out[group[g]].assign(rows.begin() + offsets[g],
+                                     rows.begin() + offsets[g] + counts[g]);
+        }
+        return out;
+    }
+
+  private:
+    template <typename Fn>
+    std::vector<EventResult> results_call_(Fn fn, const std::uint8_t *events,
+                                           std::size_t count) {
+        std::vector<EventResult> out(count ? count : 1);
+        std::int64_t n = fn(c_, events, static_cast<std::uint32_t>(count),
+                            reinterpret_cast<std::uint8_t *>(out.data()),
+                            static_cast<std::uint32_t>(out.size()));
+        if (n < 0) throw Error("request failed", static_cast<int>(n));
+        out.resize(static_cast<std::size_t>(n));
+        return out;
+    }
+
+    template <typename Rec, typename Fn>
+    std::vector<Rec> lookup_call_(Fn fn, const std::vector<U128> &ids) {
+        std::vector<Rec> out(ids.size() ? ids.size() : 1);
+        std::int64_t n = fn(c_,
+                            reinterpret_cast<const std::uint8_t *>(ids.data()),
+                            static_cast<std::uint32_t>(ids.size()),
+                            reinterpret_cast<std::uint8_t *>(out.data()),
+                            static_cast<std::uint32_t>(out.size()));
+        if (n < 0) throw Error("lookup failed", static_cast<int>(n));
+        out.resize(static_cast<std::size_t>(n));
+        return out;
+    }
+
+    tbc_client *c_;
+};
+
+}  // namespace tigerbeetle
+
+#endif /* TB_CLIENT_HPP */
