@@ -353,6 +353,64 @@ class TestReplicated:
         with _pytest.raises(AssertionError, match="LAGGING"):
             cl.check_storage_convergence()
 
+    def test_job_spans_checkpoint_and_restart_stays_convergent(self):
+        """Compaction jobs carry across checkpoints (no drain cliff): with
+        a tiny beat quota a job stays in flight through checkpoints; a
+        replica crashed and restarted MID-JOB restarts it from the
+        checkpointed descriptor and converges byte-identically."""
+        import dataclasses
+
+        from tigerbeetle_tpu.constants import TEST_MIN as _TM
+
+        cfg = dataclasses.replace(
+            _TM, name="xckpt", index_memtable_rows=128,
+            compact_quota_entries=64,
+        )
+        cl = Cluster(replica_count=3, seed=53, config=cfg)
+        c = setup_client(cl)
+        do_request(cl, c, Operation.CREATE_ACCOUNTS, account_batch([1, 2]))
+        saw_job_at_checkpoint = False
+        restarted = False
+        for i in range(60):
+            do_request(cl, c, Operation.CREATE_TRANSFERS, transfer_batch([
+                dict(id=1 + i * 20 + k, debit_account_id=1,
+                     credit_account_id=2, amount=1, ledger=1, code=1)
+                for k in range(20)
+            ]))
+            from tigerbeetle_tpu.vsr.snapshot import content_trees
+
+            r0 = cl.replicas[0]
+            if (
+                r0 is not None
+                and r0.superblock.state.op_checkpoint > 0
+                and any(
+                    t.job_state() is not None
+                    for _n, t in content_trees(r0.state_machine)
+                )
+            ):
+                saw_job_at_checkpoint = True
+                if not restarted and cl.replicas[2] is not None:
+                    # Crash + restart a backup while jobs are in flight.
+                    victim = next(
+                        r.replica for r in cl.replicas
+                        if r is not None and not r.is_primary
+                    )
+                    cl.storages[victim].sync()
+                    cl.crash_replica(victim)
+                    cl.restart_replica(victim)
+                    restarted = True
+        assert saw_job_at_checkpoint, (
+            "workload never left a job in flight at a checkpoint — "
+            "tune quota/memtable"
+        )
+        assert restarted
+        target = max(r.commit_min for r in cl.replicas if r is not None)
+        cl.run_until(lambda: all(
+            r.commit_min >= target for r in cl.replicas if r is not None
+        ), 60_000)
+        cl.check_state_convergence()
+        assert cl.check_storage_convergence() > 0
+
     def test_determinism_same_seed(self):
         def run(seed):
             cl = Cluster(replica_count=3, seed=seed, loss=0.02)

@@ -80,6 +80,10 @@ class Config:
     grid_cache_blocks: int = 1 << 12  # × 256 KiB = 1 GiB
     # Transfer-id / account-index memtable rows before a level-0 flush.
     index_memtable_rows: int = 1 << 17
+    # Compaction beat pacing: max merged entries per compact_step call
+    # (small values make jobs span many beats/checkpoints — exercised
+    # by tests; reference lsm_batch_multiple pacing).
+    compact_quota_entries: int = 1 << 15
 
 
 PRODUCTION = Config()

@@ -107,6 +107,24 @@ class FreeSet:
         self.free[index] = True
         self._low = min(self._low, index)
 
+    def reserve(self, n: int) -> list:
+        """Deterministically take the n LOWEST free blocks (reference
+        free_set.zig:28-45 reserve→acquire→forfeit: a compaction job owns
+        its output range privately, so its write order can never
+        interleave with other allocations — the keystone that lets jobs
+        span checkpoints without perturbing the deterministic layout).
+        Unused blocks are released at forfeit (plain release())."""
+        free_ix = np.nonzero(self.free[self._low :])[0] + self._low
+        if len(free_ix) < n:
+            raise RuntimeError("grid full: cannot reserve")
+        picked = free_ix[:n]
+        self.free[picked] = False
+        if n:
+            # The n lowest free blocks were just taken, so everything at
+            # or below picked[-1] is now allocated.
+            self._low = max(self._low, int(picked[-1]) + 1)
+        return [int(i) for i in picked]
+
     def stage_release(self, index: int) -> None:
         assert not self.free[index], f"double release of block {index}"
         self._staged.append(index)

@@ -25,7 +25,7 @@ import numpy as np
 
 from tigerbeetle_tpu.lsm.log import DurableLog
 from tigerbeetle_tpu.lsm.store import NOT_FOUND, pack_keys
-from tigerbeetle_tpu.lsm.tree import DurableIndex
+from tigerbeetle_tpu.lsm.tree import DEFAULT_COMPACT_QUOTA, DurableIndex
 
 # One history row: the post-event balances of the (up to two)
 # HISTORY-flagged accounts a transfer touched; u128 balances as u64 pairs.
@@ -103,8 +103,8 @@ class PostedGroove:
                 self._keys(ts), np.asarray(vals, dtype=np.uint32)
             )
 
-    def compact_step(self) -> None:
-        self.index.compact_step()
+    def compact_step(self, quota_entries: int = DEFAULT_COMPACT_QUOTA) -> None:
+        self.index.compact_step(quota_entries)
 
 
 class _PostedView:
@@ -180,8 +180,8 @@ class HistoryGroove:
         rows = self.rows.lookup_range(key)
         return self.log.gather(rows)
 
-    def compact_step(self) -> None:
-        self.rows.compact_step()
+    def compact_step(self, quota_entries: int = DEFAULT_COMPACT_QUOTA) -> None:
+        self.rows.compact_step(quota_entries)
 
     def flush_pending(self, max_blocks: int) -> None:
         self.log.flush_pending(max_blocks)

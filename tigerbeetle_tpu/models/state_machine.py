@@ -426,14 +426,15 @@ class StateMachine:
         # the RETRY after repair resumes at the faulted stage — re-running
         # completed stages would give their trees extra beats for this op
         # and diverge the deterministic allocation order from peers.
+        quota = self.config.compact_quota_entries
         stages = (
             lambda: self.transfer_log.flush_pending(max_blocks),
             lambda: self.history.flush_pending(max_blocks),
-            self.transfer_index.compact_step,
-            self.account_rows.compact_step,
-            self.query_rows.compact_step,
-            self.posted.compact_step,
-            self.history.compact_step,
+            lambda: self.transfer_index.compact_step(quota),
+            lambda: self.account_rows.compact_step(quota),
+            lambda: self.query_rows.compact_step(quota),
+            lambda: self.posted.compact_step(quota),
+            lambda: self.history.compact_step(quota),
         )
         while self._beat_stage < len(stages):
             stages[self._beat_stage]()

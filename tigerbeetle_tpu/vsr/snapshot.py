@@ -117,7 +117,10 @@ def content_logs(sm):
 def referenced_blocks(sm, tree_fences) -> np.ndarray:
     """Every CONTENT grid block the checkpoint references: object-log
     blocks, each LSM table's index block + data blocks (from
-    `tree_fences`, the fence arrays encode() already computed per tree).
+    `tree_fences`, the fence arrays encode() already computed per tree),
+    and each in-flight compaction job's block RESERVATION (the job
+    descriptor references those blocks; their content is rebuilt by the
+    restarted job, so they are allocated but not checksummed).
     The encoded free set is derived from THIS — references-exact by
     construction, so it is byte-deterministic across replicas regardless
     of allocation history. The checkpoint trailer's own blocks are
@@ -132,6 +135,9 @@ def referenced_blocks(sm, tree_fences) -> np.ndarray:
             for t in level:
                 blocks.append(t.index_block)
         blocks.extend(fences["block"].tolist())
+        st = tree.job_state()
+        if st is not None:
+            blocks.extend(st[2])
     if blocks:
         free[np.array(blocks, dtype=np.int64)] = False
     return free
@@ -173,7 +179,10 @@ def encode(replica) -> bytes:
         reply_blobs.append(raw)
 
     sections = dict(
-        version=np.uint32(4),
+        # v5: config_epoch/slot_epochs (r5), qi query tree, per-tree
+        # compaction-job descriptors. No migration path from v4 — data
+        # files are not carried across builds; the bump is diagnostic.
+        version=np.uint32(5),
         account_count=np.int64(count),
         acc_key_hi=sm.acc_key["hi"][:count], acc_key_lo=sm.acc_key["lo"][:count],
         acc_ud128_lo=sm.acc_user_data_128_lo[:count],
@@ -209,6 +218,17 @@ def encode(replica) -> bytes:
         sections[f"{name}_fences"] = fences
         sections[f"{name}_fence_counts"] = counts
         tree_fences.append(fences)
+        # In-flight compaction job descriptor (jobs span checkpoints;
+        # see DurableIndex.checkpoint): (level, n_inputs, progress) +
+        # reservation.
+        st = tree.job_state()
+        sections[f"{name}_job"] = (
+            np.array([st[0], st[1], st[2]], dtype=np.uint64)
+            if st is not None else np.zeros(0, dtype=np.uint64)
+        )
+        sections[f"{name}_job_resv"] = np.array(
+            st[3] if st is not None else [], dtype=np.uint32
+        )
         ref.extend(
             t.index_block for level in tree.levels for t in level
         )
@@ -264,7 +284,7 @@ _LOCAL_REQUIRED = (
     "prepare_timestamp", "commit_timestamp", "config_epoch",
     "slot_epochs", "client_table", "client_replies",
     *(f"{p}_{s}" for p in _TREE_PREFIXES
-      for s in ("manifest", "fences", "fence_counts")),
+      for s in ("manifest", "fences", "fence_counts", "job", "job_resv")),
     *(f"{p}_{s}" for p in _LOG_PREFIXES for s in ("blocks", "tail")),
     "block_cks", "free_set",
 )
@@ -374,6 +394,12 @@ def install(replica, blob: bytes, rebuild_bloom: bool = True,
     for name, tree in content_trees(sm):
         tree.restore(z[f"{name}_manifest"])
         tree.attach_fences(z[f"{name}_fences"], z[f"{name}_fence_counts"])
+        job = z[f"{name}_job"]
+        if len(job):
+            tree.restore_job(
+                int(job[0]), int(job[1]), int(job[2]),
+                z[f"{name}_job_resv"].tolist(),
+            )
     for name, dlog in content_logs(sm):
         dlog.restore(z[f"{name}_blocks"], z[f"{name}_tail"])
     if rebuild_bloom:
