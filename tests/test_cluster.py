@@ -362,6 +362,10 @@ class TestReplicated:
 
         from tigerbeetle_tpu.constants import TEST_MIN as _TM
 
+        import io as _io
+
+        from tigerbeetle_tpu.vsr.snapshot import _TREE_PREFIXES
+
         cfg = dataclasses.replace(
             _TM, name="xckpt", index_memtable_rows=128,
             compact_quota_entries=64,
@@ -369,28 +373,33 @@ class TestReplicated:
         cl = Cluster(replica_count=3, seed=53, config=cfg)
         c = setup_client(cl)
         do_request(cl, c, Operation.CREATE_ACCOUNTS, account_batch([1, 2]))
-        saw_job_at_checkpoint = False
+
+        def trailer_has_job(r) -> bool:
+            """Does the replica's DURABLE trailer carry a live job
+            descriptor? (The whole feature under test: a job that was in
+            flight at the moment a checkpoint ENCODED.)"""
+            st = r.superblock.state
+            if st.op_checkpoint == 0:
+                return False
+            blob = r._trailer_read(st.trailer_block)
+            with np.load(_io.BytesIO(blob)) as z:
+                return any(len(z[f"{p}_job"]) > 0 for p in _TREE_PREFIXES)
+
+        saw_persisted_job = False
         restarted = False
         for i in range(60):
             do_request(cl, c, Operation.CREATE_TRANSFERS, transfer_batch([
-                dict(id=1 + i * 20 + k, debit_account_id=1,
+                dict(id=1 + i * 64 + k, debit_account_id=1,
                      credit_account_id=2, amount=1, ledger=1, code=1)
-                for k in range(20)
+                for k in range(64)
             ]))
-            from tigerbeetle_tpu.vsr.snapshot import content_trees
-
             r0 = cl.replicas[0]
-            if (
-                r0 is not None
-                and r0.superblock.state.op_checkpoint > 0
-                and any(
-                    t.job_state() is not None
-                    for _n, t in content_trees(r0.state_machine)
-                )
-            ):
-                saw_job_at_checkpoint = True
+            if r0 is not None and trailer_has_job(r0):
+                saw_persisted_job = True
                 if not restarted and cl.replicas[2] is not None:
-                    # Crash + restart a backup while jobs are in flight.
+                    # Crash + restart a backup while its trailer carries
+                    # the mid-flight job: restore_job + the deferred
+                    # fast-forward must reconverge it byte-identically.
                     victim = next(
                         r.replica for r in cl.replicas
                         if r is not None and not r.is_primary
@@ -399,8 +408,8 @@ class TestReplicated:
                     cl.crash_replica(victim)
                     cl.restart_replica(victim)
                     restarted = True
-        assert saw_job_at_checkpoint, (
-            "workload never left a job in flight at a checkpoint — "
+        assert saw_persisted_job, (
+            "no checkpoint trailer ever carried a job descriptor — "
             "tune quota/memtable"
         )
         assert restarted

@@ -82,7 +82,9 @@ class Config:
     index_memtable_rows: int = 1 << 17
     # Compaction beat pacing: max merged entries per compact_step call
     # (small values make jobs span many beats/checkpoints — exercised
-    # by tests; reference lsm_batch_multiple pacing).
+    # by tests; reference lsm_batch_multiple pacing). Sourced from
+    # lsm.tree.DEFAULT_COMPACT_QUOTA via __post_init__-free default: the
+    # literal must equal it (asserted in lsm/tree.py import sites).
     compact_quota_entries: int = 1 << 15
 
 

@@ -67,7 +67,14 @@ BLOCK_TYPE_INDEX = 2
 
 # Default beat quota (entries merged per compact_step): the single source
 # for every pacing default; Config.compact_quota_entries overrides.
+# constants.py cannot import this module (cycle via io.grid), so its
+# default duplicates the literal — asserted equal here.
 DEFAULT_COMPACT_QUOTA = 1 << 15
+
+from tigerbeetle_tpu.constants import Config as _Config  # noqa: E402
+
+assert _Config.compact_quota_entries == DEFAULT_COMPACT_QUOTA
+del _Config
 
 
 @dataclass(eq=False)  # identity equality: tables live in LRU lists
@@ -342,17 +349,18 @@ class DurableIndex:
         if self._job is None:
             if self._aborted_resv is not None:
                 # Retry after a repaired fault: recreate the SAME job —
-                # captured inputs and reservation — so the restarted
-                # merge rewrites the same blocks (determinism vs peers
-                # that never faulted). It must run before any OTHER
-                # level's job is considered, or its reservation would
-                # leak and the eventual re-reserve would pick different
-                # indices.
-                level, tables, resv = self._aborted_resv
+                # captured inputs, reservation, and completed progress —
+                # so the restarted merge rewrites the same blocks and
+                # installs at the op peers do. It must run before any
+                # OTHER level's job is considered, or its reservation
+                # would leak and the eventual re-reserve would pick
+                # different indices.
+                level, tables, resv, p0 = self._aborted_resv
                 self._aborted_resv = None
                 self._job = _CompactionJob(
                     self, level, tables, reservation=resv
                 )
+                self._job.pending_ff = p0
             else:
                 for level, tables in enumerate(self.levels):
                     if len(tables) > self.growth:
@@ -361,16 +369,28 @@ class DurableIndex:
         if self._job is None:
             return False
         try:
-            if self._job.step(quota_entries):
+            # A restored job's deferred fast-forward folds into this
+            # step's quota (see restore_job) — same stopping point as a
+            # replica that ran the forward and the beat separately. The
+            # owed forward is only consumed on SUCCESS: a fault mid-step
+            # discards the step's merges, so the retry still owes it.
+            quota = quota_entries + self._job.pending_ff
+            exhausted = self._job.step(quota)
+            self._job.pending_ff = 0
+            if exhausted:
                 self._install_job()
         except GridReadFault:
             # A corrupt input block: the step is NOT resumable (streams
             # were partially consumed), but abort-and-retry is exactly
-            # deterministic — inputs and reservation are kept for the
-            # retried job, which rewrites the same blocks after repair.
+            # deterministic — inputs, reservation, AND the owed position
+            # (completed progress + any unconsumed fast-forward) are
+            # kept, so the retried job forwards to the position peers
+            # hold and stays install-op aligned.
+            owed = self._job.progress_at_step_start + self._job.pending_ff
             self._job.writer.abort()
             self._aborted_resv = (
-                self._job.level, self._job.tables, self._job.reservation
+                self._job.level, self._job.tables, self._job.reservation,
+                owed,
             )
             self._job = None
             raise
@@ -742,26 +762,23 @@ class DurableIndex:
         self, level: int, n_inputs: int, progress: int,
         reservation: List[int],
     ) -> None:
-        """Recreate a checkpointed job descriptor and FAST-FORWARD the
-        re-merge to the checkpointed progress: it rewrites the same
-        reserved blocks (content and indices identical) and — because it
-        resumes at the same position — INSTALLS at the same future op as
-        a replica that never restarted. Without the fast-forward, the
-        restarted replica would install progress/quota beats late and
-        checkpoints in that window would diverge."""
+        """Recreate a checkpointed job descriptor. The re-merge is
+        FAST-FORWARDED to the checkpointed progress LAZILY, on the first
+        compact_step (pending_ff): install() may run on block-sync paths
+        where the input blocks are not locally present yet, and commits
+        (hence beats) are gated until they are. Folding the forward into
+        the first beat's quota lands on the identical chunk-stream
+        crossing a running replica reached (first crossing >= p, then
+        >= p+q, equals first crossing >= p+q when p is itself a
+        crossing), so the restarted job installs at the same future op
+        as a replica that never restarted — and a fault during the
+        forward takes compact_step's abort path like any other."""
         tables = self.levels[level][:n_inputs]
         assert len(tables) == n_inputs
         self._job = _CompactionJob(
             self, level, tables, reservation=list(reservation)
         )
-        if progress:
-            # Progress is a chunk-stream crossing point (see
-            # _CompactionJob.progress), so one step with quota=progress
-            # stops exactly there.
-            exhausted = self._job.step(progress)
-            assert not exhausted and self._job.progress == progress, (
-                "fast-forward did not land on the checkpointed position"
-            )
+        self._job.pending_ff = progress
 
     def restore(self, manifest: np.ndarray) -> None:
         self._mem = []
@@ -822,9 +839,16 @@ class _CompactionJob:
         # running (chunk boundaries are deterministic, so progress is
         # always a reproducible crossing point of the chunk stream).
         self.progress = 0
+        # Deferred fast-forward amount for a descriptor-restored job
+        # (consumed by compact_step's first beat; see restore_job).
+        self.pending_ff = 0
+        # Progress as of the last completed step — the retry position
+        # after a fault-aborted step (its partial merges are discarded).
+        self.progress_at_step_start = 0
 
     def step(self, quota_entries: int) -> bool:
         """Merge ≥1 chunk, up to ~quota_entries; True when exhausted."""
+        self.progress_at_step_start = self.progress
         merged = 0
         while merged < quota_entries:
             live = [s for s in self.streams if not s.exhausted()]

@@ -137,7 +137,7 @@ def referenced_blocks(sm, tree_fences) -> np.ndarray:
         blocks.extend(fences["block"].tolist())
         st = tree.job_state()
         if st is not None:
-            blocks.extend(st[2])
+            blocks.extend(st[3])  # the reservation block list
     if blocks:
         free[np.array(blocks, dtype=np.int64)] = False
     return free
