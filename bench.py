@@ -648,7 +648,7 @@ def bench_e2e():
                 sys.executable, "-m", "tigerbeetle_tpu.cli", "benchmark",
                 "--accounts=10000", f"--transfers={E2E_TRANSFERS}",
                 "--backend=numpy", f"--port={port}", "--queries=100",
-                "--clients=2",
+                "--clients=3",
             ],
             capture_output=True, text=True, timeout=900, env=env,
             cwd=os.path.dirname(os.path.abspath(__file__)),
