@@ -239,6 +239,64 @@ int hostops_argsort_u64(int64_t n, const uint64_t *keys, uint32_t *out) {
     return 0;
 }
 
+/* Fused stable lo-major sort of (16-byte key, u32 value) pairs: radix
+ * argsort of the key lo-halves + ONE gather of keys and values in C —
+ * the LSM flush path's hot pair (sort + numpy fancy-index gather) in a
+ * single call. keys_in/keys_out are KEY_DTYPE rows (hi u64 FIRST, then
+ * lo u64 — lsm/store.py layout). */
+typedef struct {
+    uint64_t lo;   /* the sort key */
+    uint32_t row;  /* original position: resolves keys_out/vals_out */
+    uint32_t _pad;
+} sortkv_ent;
+
+int hostops_sort_kv(
+    int64_t n, const uint64_t *keys_in, const uint32_t *vals_in,
+    uint64_t *keys_out, uint32_t *vals_out
+) {
+    /* Pair-moving LSD radix: each pass streams 16-byte (lo, row)
+     * elements sequentially instead of double-indirecting through an
+     * index permutation (keys[idx[i]] per pass is a cache miss per
+     * element; this is ~4x faster at memtable sizes). Stable by lo. */
+    sortkv_ent *cur = (sortkv_ent *)malloc((size_t)n * sizeof(sortkv_ent));
+    sortkv_ent *alt = (sortkv_ent *)malloc((size_t)n * sizeof(sortkv_ent));
+    if (!cur || !alt) { free(cur); free(alt); return -1; }
+    for (int64_t i = 0; i < n; i++) {
+        cur[i].lo = keys_in[2 * i + 1]; /* KEY_DTYPE: hi first, lo second */
+        cur[i].row = (uint32_t)i;
+    }
+    uint64_t counts[256];
+    for (int pass = 0; pass < 8; pass++) {
+        int shift = pass * 8;
+        uint8_t first = (uint8_t)(cur[0].lo >> shift);
+        int constant = 1;
+        memset(counts, 0, sizeof(counts));
+        for (int64_t i = 0; i < n; i++) {
+            uint8_t b = (uint8_t)(cur[i].lo >> shift);
+            counts[b]++;
+            constant &= (b == first);
+        }
+        if (constant) continue;
+        uint64_t pos = 0;
+        uint64_t starts[256];
+        for (int b = 0; b < 256; b++) { starts[b] = pos; pos += counts[b]; }
+        for (int64_t i = 0; i < n; i++) {
+            uint8_t b = (uint8_t)(cur[i].lo >> shift);
+            alt[starts[b]++] = cur[i];
+        }
+        sortkv_ent *t = cur; cur = alt; alt = t;
+    }
+    for (int64_t i = 0; i < n; i++) {
+        int64_t j = (int64_t)cur[i].row;
+        keys_out[2 * i] = keys_in[2 * j];
+        keys_out[2 * i + 1] = keys_in[2 * j + 1];
+        vals_out[i] = vals_in[j];
+    }
+    free(cur);
+    free(alt);
+    return 0;
+}
+
 /* ------------------------------------------------- fast-path staging */
 
 /* One pass over raw 128-byte wire Transfer records doing everything the

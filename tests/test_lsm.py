@@ -424,3 +424,26 @@ class TestCrossCheckpointCompaction:
         fb, cb = tree_b2.checkpoint_fences()
         assert fa.tobytes() == fb.tobytes()
         assert ca.tobytes() == cb.tobytes()
+
+
+class TestSortKv:
+    """The fused C sort+gather (hostops_sort_kv) must match the two-step
+    numpy path bit-for-bit — including tie stability — ABOVE the 512-row
+    threshold where the C branch engages (a KEY_DTYPE layout change
+    breaking the C's hi-first offsets would otherwise corrupt every
+    flushed table with green small-array tests)."""
+
+    def test_matches_numpy_above_threshold(self):
+        from tigerbeetle_tpu.lsm.store import sort_kv, sort_lo_major
+
+        rng = np.random.default_rng(3)
+        for n, lo_span in ((600, 1 << 62), (5000, 8), (131072, 1 << 62)):
+            keys = pack_keys(
+                rng.integers(0, lo_span, n, dtype=np.uint64),
+                rng.integers(0, 1 << 60, n, dtype=np.uint64),
+            )
+            vals = rng.integers(0, 1 << 31, n, dtype=np.uint32)
+            order = sort_lo_major(keys)
+            k2, v2 = sort_kv(keys, vals)
+            assert k2.tobytes() == keys[order].tobytes(), n
+            assert v2.tobytes() == vals[order].tobytes(), n

@@ -35,6 +35,7 @@ from tigerbeetle_tpu.lsm.store import (
     KEY_DTYPE,
     NOT_FOUND,
     search_run,
+    sort_kv,
     sort_lo_major,
 )
 
@@ -256,11 +257,11 @@ class DurableIndex:
             return
         keys = np.concatenate([k for k, _ in self._mem])
         vals = np.concatenate([v for _, v in self._mem])
-        order = sort_lo_major(keys)
+        keys, vals = sort_kv(keys, vals)  # fused C sort+gather
         self._mem = []
         self._mem_sorted = []
         self._mem_count = 0
-        table = self._build_table(keys[order], vals[order])
+        table = self._build_table(keys, vals)
         self.levels[0].append(table)
 
     def _build_table(self, keys: np.ndarray, vals: np.ndarray) -> TableInfo:

@@ -127,6 +127,12 @@ def hostops() -> Optional[ctypes.CDLL]:
     lib.hostops_build_sorted_kv.restype = ctypes.c_int
     lib.hostops_extract_kv.argtypes = lib.hostops_build_sorted_kv.argtypes
     lib.hostops_extract_kv.restype = ctypes.c_int
+    # Fused flush-path sort+gather. Guarded: a stale pre-r5 .so (mtime
+    # newer than the source, e.g. copied around) must degrade to the
+    # numpy fallback in sort_kv, not AttributeError inside a flush.
+    if hasattr(lib, "hostops_sort_kv"):
+        lib.hostops_sort_kv.argtypes = [ctypes.c_int64, u64p, u32p, u64p, u32p]
+        lib.hostops_sort_kv.restype = ctypes.c_int
     # The C staging ladder hardcodes the wire-contract result codes; refuse
     # the shim (fall back to numpy) if the enums ever drift.
     from tigerbeetle_tpu.results import CreateTransferResult as _TR
