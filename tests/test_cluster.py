@@ -443,6 +443,86 @@ class TestReplicated:
         assert run(12) == run(12)
 
 
+class TestOverlappedPipeline:
+    """Determinism guard for the overlapped commit stage
+    (vsr/pipeline.py): the SAME workload through a serial cluster and an
+    overlap=True cluster must produce byte-identical hash_log commit
+    chains and byte-identical checkpoint trailer digests — execution
+    timing moves off the event loop, the committed chain must not."""
+
+    OPS = 40  # past two TEST_MIN checkpoint intervals (16)
+
+    def _drive(self, overlap: bool, hash_log=None):
+        from tigerbeetle_tpu.testing.hash_log import attach_to_cluster
+        from tigerbeetle_tpu.vsr.clock import Clock, DeterministicTime
+
+        cl = Cluster(replica_count=3, seed=9, overlap=overlap)
+        # Freeze wall time (tick_ns=0): prepare timestamps then derive
+        # from the op stream alone, so the two runs' committed BYTES can
+        # be compared even though reply latency (and so request arrival
+        # ticks) differs between serial and overlapped execution.
+        for r in cl.replicas:
+            r.time = DeterministicTime(tick_ns=0)
+            r.clock = Clock(r.time, cl.replica_count, r.replica)
+        attach_to_cluster(cl, hash_log)
+        try:
+            c = setup_client(cl)
+            do_request(cl, c, Operation.CREATE_ACCOUNTS, account_batch([1, 2]))
+            for i in range(self.OPS):
+                do_request(cl, c, Operation.CREATE_TRANSFERS, transfer_batch([
+                    dict(id=1 + i * 4 + k, debit_account_id=1,
+                         credit_account_id=2, amount=1 + k, ledger=1, code=1)
+                    for k in range(4)
+                ]))
+            target = cl.replicas[0].commit_min
+            cl.run_until(lambda: all(
+                r.commit_min >= target for r in cl.replicas if r is not None
+            ), 60_000)
+            cl.quiesce()
+            if overlap:
+                # The stage actually ran: every replica committed through
+                # the executor, none fell back to the serial inline path.
+                assert all(
+                    r.executor is not None for r in cl.replicas if r is not None
+                )
+            chains = [
+                dict(r.commit_checksums) for r in cl.replicas if r is not None
+            ]
+            checkpoints = {
+                r.replica: r.superblock.state.op_checkpoint
+                for r in cl.replicas if r is not None
+            }
+            digests = {
+                r.replica: Cluster._section_digests(Cluster._trailer_sections(r))
+                for r in cl.replicas if r is not None
+            }
+            assert cl.check_state_convergence() >= self.OPS
+            assert cl.check_storage_convergence() >= 16
+            return chains, checkpoints, digests
+        finally:
+            cl.close()
+
+    def test_overlap_vs_serial_hash_log_and_storage_identical(self, tmp_path):
+        from tigerbeetle_tpu.testing.hash_log import HashLog
+
+        path = str(tmp_path / "hash.log")
+        create = HashLog(path, "create")
+        serial_chains, serial_cp, serial_digests = self._drive(
+            overlap=False, hash_log=create
+        )
+        create.close()
+        # The overlapped run CHECKS the serial run's hash log: the first
+        # divergent commit checksum fails at its source op.
+        check = HashLog(path, "check")
+        overlap_chains, overlap_cp, overlap_digests = self._drive(
+            overlap=True, hash_log=check
+        )
+        check.close()
+        assert serial_chains == overlap_chains
+        assert serial_cp == overlap_cp and all(v >= 16 for v in serial_cp.values())
+        assert serial_digests == overlap_digests
+
+
 class TestQueryOps:
     """QUERY_ACCOUNTS / QUERY_TRANSFERS through consensus, and the query
     index surviving checkpoint + restart (it is a content tree in the

@@ -19,3 +19,112 @@ def test_vopr_big_batch_schedule():
     assert sim.run() == EXIT_PASS
     # At least one full-size batch actually crossed the VSR path.
     assert sim.workload.largest_batch == 8190
+
+
+def test_overlap_stage_gates_on_grid_repair_and_checkpoint():
+    """Gating correctness for the overlapped commit stage: a seeded
+    schedule corrupts a grid block on a backup so a committed query
+    FAULTS inside the executor stage, while later ops are already staged
+    behind it, and then drives the cluster across a checkpoint. The stage
+    must park, hand the reclaimed ops back to the journal path, repair
+    the one block, and resume — with every replica executing strictly
+    op, op+1, op+2, … (never out of order, never twice), and checkpoint
+    trailers byte-convergent afterwards."""
+    import numpy as np
+
+    from tigerbeetle_tpu import types
+    from tigerbeetle_tpu.testing.cluster import (
+        Cluster, account_batch, transfer_batch,
+    )
+    from tigerbeetle_tpu.vsr.header import Operation
+
+    cl = Cluster(replica_count=3, seed=77, overlap=True)
+    try:
+        # Record every replica's execution order (the commit event fires
+        # on the executor thread, in execution order).
+        executed = {r.replica: [] for r in cl.replicas}
+        events = {r.replica: [] for r in cl.replicas}
+        for r in cl.replicas:
+            orig = r.on_event
+
+            def hook(kind, rep, _orig=orig):
+                if kind == "commit":
+                    executed[rep.replica].append(rep.last_committed_op)
+                elif kind in ("grid_repair", "checkpoint"):
+                    events[rep.replica].append(kind)
+                _orig(kind, rep)
+
+            r.on_event = hook
+
+        c = cl.clients[100]
+        c.register()
+        cl.run_until(lambda: c.registered)
+
+        def req(op, body):
+            c.request(op, body)
+            cl.run_until(lambda: c.idle, 60_000)
+            return c.replies[-1]
+
+        req(Operation.CREATE_ACCOUNTS, account_batch([1, 2]))
+        # Flush at least one object-log grid block everywhere.
+        i = 0
+        while not all(
+            r is not None and len(r.state_machine.transfer_log.blocks) > 0
+            for r in cl.replicas
+        ):
+            req(Operation.CREATE_TRANSFERS, transfer_batch([
+                dict(id=1 + i * 10 + k, debit_account_id=1,
+                     credit_account_id=2, amount=1, ledger=1, code=1)
+                for k in range(10)
+            ]))
+            i += 1
+            assert i < 50
+        backup = next(r for r in cl.replicas if r is not None and not r.is_primary)
+        cl.quiesce()
+        grid = backup.state_machine.grid
+        block = backup.state_machine.transfer_log.blocks[0]
+        cl.storages[backup.replica].write(
+            grid._addr(block), b"\xde\xad" * (grid.block_size // 2)
+        )
+        cl.storages[backup.replica].sync()
+        grid.drop_cache()
+        # The committed query faults in the backup's executor stage; the
+        # following transfers are staged behind it before the repair.
+        f = np.zeros(1, dtype=types.ACCOUNT_FILTER_DTYPE)
+        f["account_id_lo"] = 1
+        f["limit"] = 100
+        f["flags"] = 0x3
+        c.request(Operation.GET_ACCOUNT_TRANSFERS, f.tobytes())
+        cl.run_until(lambda: c.idle, 60_000)
+        # Drive across a checkpoint (TEST_MIN interval 16) while the
+        # backup repairs and catches up.
+        for j in range(24):
+            req(Operation.CREATE_TRANSFERS, transfer_batch([
+                dict(id=9000 + j, debit_account_id=1, credit_account_id=2,
+                     amount=1, ledger=1, code=1),
+            ]))
+        target = max(r.commit_min for r in cl.replicas if r is not None)
+        cl.run_until(
+            lambda: backup._grid_repair is None
+            and all(r.commit_min >= target for r in cl.replicas if r is not None),
+            80_000,
+        )
+        cl.quiesce()
+        # The fault actually happened and was repaired in place.
+        assert "grid_repair" in events[backup.replica]
+        assert grid.local_checksum(block) is not None
+        # Checkpoints crossed on a quiescent stage, on every replica.
+        assert all(
+            r.superblock.state.op_checkpoint >= 16
+            for r in cl.replicas if r is not None
+        )
+        # In-order, exactly-once execution on every replica — including
+        # across the park/reclaim/repair/resume cycle.
+        for rep, ops in executed.items():
+            assert ops == list(range(1, len(ops) + 1)), (
+                f"replica {rep} executed out of order: {ops[-10:]}"
+            )
+        cl.check_state_convergence()
+        assert cl.check_storage_convergence() >= 16
+    finally:
+        cl.close()

@@ -101,7 +101,31 @@ def cmd_start(args) -> int:
         time=SystemTime(),
         aof=aof,
     )
-    server = ReplicaServer(replica, addresses)
+    # Overlapped commit pipeline by default (docs/COMMIT_PIPELINE.md):
+    # WAL writer + commit-executor stages are wired by ReplicaServer.start.
+    # --serial-commit keeps commits inline on the event loop (debug knob /
+    # apples-to-apples comparison; the deterministic simulator is always
+    # serial by construction — it never builds a ReplicaServer).
+    # The overlapped stage needs a core to run on: with fewer than 3 CPUs
+    # the executor thread just time-slices against the event loop (and
+    # the co-located bench client), paying GIL handoffs for no
+    # parallelism — auto-select the serial fallback there.
+    # TIGERBEETLE_TPU_OVERLAP=1/0 forces either way.
+    force = _os.environ.get("TIGERBEETLE_TPU_OVERLAP")
+    if force is not None:
+        overlap = force not in ("", "0")
+    else:
+        overlap = (_os.cpu_count() or 1) >= 3
+    overlap = overlap and not args.serial_commit
+    if overlap:
+        # The executor thread's numpy stints and the event loop contend
+        # for the GIL: the switch interval trades executor burst length
+        # against request-intake latency. TIGERBEETLE_TPU_SWITCH_INTERVAL
+        # overrides for tuning; the default keeps CPython's 5ms.
+        si = _os.environ.get("TIGERBEETLE_TPU_SWITCH_INTERVAL")
+        if si:
+            sys.setswitchinterval(float(si))
+    server = ReplicaServer(replica, addresses, overlap=overlap)
     replica.open()
     host, port = addresses[args.replica]
 
@@ -109,28 +133,6 @@ def cmd_start(args) -> int:
         # Bind BEFORE announcing: tooling (benchmark driver, scripts) waits
         # for this line and connects immediately.
         await server.start()
-        # WAL writer thread: durable O_DIRECT|O_DSYNC body writes off the
-        # event loop (buffered+fdatasync group commit where direct IO is
-        # unavailable); callbacks fail-stop like bus dispatch does.
-        from tigerbeetle_tpu.vsr.journal import WalWriter
-
-        loop = asyncio.get_running_loop()
-
-        def _guarded(cb) -> None:
-            try:
-                cb()
-            except Exception:
-                import traceback as _tb
-
-                print("replica raised in WAL-durable callback — failing stop:\n"
-                      + _tb.format_exc(), file=sys.stderr, flush=True)
-                server.stop()
-                raise
-
-        replica.wal_writer = WalWriter(
-            storage, lambda cb: loop.call_soon_threadsafe(_guarded, cb)
-        )
-        replica.journal.writer = replica.wal_writer
         print(f"replica {args.replica}/{len(addresses)} listening on {host}:{port} "
               f"(backend={args.backend}, status={replica.status})", flush=True)
         await server.serve_forever()
@@ -285,12 +287,15 @@ def cmd_benchmark(args) -> int:
             path=path, cluster=0, replica=0, replica_count=1, config=args.config
         ))
         assert rc == 0
+        server_args = [
+            sys.executable, "-m", "tigerbeetle_tpu.cli", "start",
+            f"--addresses=127.0.0.1:{port}", "--replica=0",
+            f"--config={args.config}", f"--backend={args.backend}",
+        ]
+        if args.serial_commit:
+            server_args.append("--serial-commit")
         proc = subprocess.Popen(
-            [
-                sys.executable, "-m", "tigerbeetle_tpu.cli", "start",
-                f"--addresses=127.0.0.1:{port}", "--replica=0",
-                f"--config={args.config}", f"--backend={args.backend}", path,
-            ],
+            server_args + [path],
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
         )
         try:
@@ -473,6 +478,9 @@ def main(argv=None) -> int:
                    help="active replicas; addresses beyond this are standbys")
     s.add_argument("--aof", action="store_true",
                    help="append committed prepares to <path>.aof")
+    s.add_argument("--serial-commit", action="store_true",
+                   help="disable the overlapped commit stage (execute "
+                        "inline on the event loop)")
     s.set_defaults(fn=cmd_start)
 
     a = sub.add_parser("aof", help="AOF debug/merge/recover tooling")
@@ -507,6 +515,9 @@ def main(argv=None) -> int:
     b.add_argument("--rate", type=int, default=1_000_000)
     b.add_argument("--config", default="production")
     b.add_argument("--backend", default="jax", choices=["jax", "numpy"])
+    b.add_argument("--serial-commit", action="store_true",
+                   help="run the server with the overlapped commit stage "
+                        "disabled (A/B comparison)")
     b.set_defaults(fn=cmd_benchmark)
 
     args = p.parse_args(argv)

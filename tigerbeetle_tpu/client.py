@@ -399,7 +399,11 @@ class AsyncClient:
     async def _connect(self, r: int) -> Optional[asyncio.StreamWriter]:
         host, port = self.addresses[r]
         try:
-            reader, writer = await asyncio.open_connection(host, port)
+            # 2 MiB limit: a full reply buffers in one gulp instead of 16
+            # pause/resume cycles of the default 64 KiB feed.
+            reader, writer = await asyncio.open_connection(
+                host, port, limit=1 << 21
+            )
         except OSError:
             return None
         self._writers[r] = writer

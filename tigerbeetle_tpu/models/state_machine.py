@@ -262,6 +262,14 @@ class StateMachine:
         # GridReadFault was repaired (see compact_beat).
         self._beat_stage = 0
 
+        # Split-phase device dispatch (the overlapped commit pipeline,
+        # vsr/pipeline.py): FIFO of outstanding handles whose kernels are
+        # dispatched but not yet synced (finish pops strictly in dispatch
+        # order); _state_gen fences handles that chained off a state token
+        # a serial bail rolled back.
+        self._ct_pending: list = []
+        self._state_gen = 0
+
         # telemetry: how many batches took which path
         self.stats = {
             "fast_batches": 0, "exact_batches": 0,
@@ -318,30 +326,27 @@ class StateMachine:
                 np.asarray(ts, dtype=np.uint64)
                 if ts is not None else recs["timestamp"]
             )
-            parts = [
-                scan.composite_keys(
-                    scan.TAG_UD128,
-                    scan.fold56(
-                        recs["user_data_128_lo"], recs["user_data_128_hi"]
-                    ),
-                    tstamp,
-                ),
-                scan.composite_keys(
-                    scan.TAG_UD64, scan.fold56(recs["user_data_64"]), tstamp,
-                ),
-                scan.composite_keys(
-                    scan.TAG_UD32, scan.fold56(recs["user_data_32"]), tstamp,
-                ),
-                scan.composite_keys(
-                    scan.TAG_LEDGER, scan.fold56(recs["ledger"]), tstamp,
-                ),
-                scan.composite_keys(
-                    scan.TAG_CODE, scan.fold56(recs["code"]), tstamp,
-                ),
-            ]
-            self.query_rows.insert_unsorted(
-                np.concatenate(parts), np.tile(rows, len(parts)),
+            # One preallocated key block filled slice-wise (identical
+            # bytes to the old per-tag build + concatenate, minus the
+            # five temporaries and the 5n-row copy on the commit path).
+            tags = (
+                (scan.TAG_UD128, scan.fold56(
+                    recs["user_data_128_lo"], recs["user_data_128_hi"]
+                )),
+                (scan.TAG_UD64, scan.fold56(recs["user_data_64"])),
+                (scan.TAG_UD32, scan.fold56(recs["user_data_32"])),
+                (scan.TAG_LEDGER, scan.fold56(recs["ledger"])),
+                (scan.TAG_CODE, scan.fold56(recs["code"])),
             )
+            n = len(recs)
+            keys = np.empty(len(tags) * n, dtype=scan.KEY_DTYPE)
+            klo, khi = keys["lo"], keys["hi"]
+            for i, (tag, folded) in enumerate(tags):
+                klo[i * n : (i + 1) * n] = (
+                    np.uint64(tag) << np.uint64(56)
+                ) | folded
+                khi[i * n : (i + 1) * n] = tstamp
+            self.query_rows.insert_unsorted(keys, np.tile(rows, len(tags)))
 
     def _store_native(self, recs: np.ndarray, row_base: int) -> bool:
         """C-fused index staging (hostops_build_sorted_kv): builds the
@@ -648,6 +653,18 @@ class StateMachine:
                 pend, maybe, bits)
 
     def create_transfers(self, events: np.ndarray, timestamp: Optional[int] = None) -> np.ndarray:
+        # The overlapped pipeline must finish (or abandon) its dispatched
+        # handles before any op takes the single-phase path — interleaving
+        # would reorder stores against the kernel chain. (The stale-gen
+        # refire inside create_transfers_finish is the one sanctioned
+        # exception: it gen-fences every outstanding handle first and
+        # enters through _create_transfers_impl.)
+        assert not self._ct_pending, "unfinished split-phase dispatch"
+        return self._create_transfers_impl(events, timestamp)
+
+    def _create_transfers_impl(
+        self, events: np.ndarray, timestamp: Optional[int] = None
+    ) -> np.ndarray:
         self.flush_deferred()
         events = np.atleast_1d(events)
         n = len(events)
@@ -789,6 +806,127 @@ class StateMachine:
             self._store_new_transfers(recs)
             self.commit_timestamp = int(ts[ok][-1])
         return _codes_to_results(codes)
+
+    # --- split-phase device dispatch (double-buffered commit pipeline) --
+    #
+    # The serial device path strictly alternates: pack batch N, dispatch
+    # its kernel, BLOCK on np.asarray(codes) device→host sync, store, then
+    # batch N+1. The split-phase pair lets the commit pipeline dispatch
+    # batch N+1's validate/balance kernel while batch N's sync is still in
+    # flight — TPU compute overlaps host post-processing. Determinism:
+    # results are byte-identical to the serial path because (a) only
+    # batches whose routing is INDEPENDENT of the outstanding batch are
+    # dispatched ahead (id-disjointness guard below — the dup check of
+    # batch N+1 must see batch N's stored ids), and (b) stores still land
+    # strictly in op order (dispatch writes nothing; finish stores).
+
+    def create_transfers_dispatch(self, events: np.ndarray, timestamp: int):
+        """Stage + dispatch the device fast kernel WITHOUT syncing.
+        Returns a handle for create_transfers_finish, or None when the
+        batch routes anywhere but the fast device path (duplicates,
+        exact-kernel flags, pending/post-void, id overlap with the
+        outstanding handle, no device backend) — the caller then runs the
+        ordinary create_transfers at its op's turn."""
+        if self._ops is None or self.mesh is not None:
+            return None
+        events = np.atleast_1d(events)
+        n = len(events)
+        if n == 0:
+            return None
+        self.flush_deferred()
+        staged = self._ct_stage_native(events, timestamp)
+        if staged is None:
+            return None  # no C staging shim: keep the single-phase path
+        (code, host_code, dr_slots, cr_slots, _alo, _ahi,
+         _pend, maybe_u8, bits) = staged
+        # bit 1: in-batch duplicate ids → serial; bit 2: exact kernel
+        # route; bit 8: post/void of an id in this batch → serial.
+        if bits & (1 | 2 | 8):
+            return None
+        for pending in self._ct_pending:
+            # An outstanding batch's OK ids are not in the bloom/index yet
+            # (its store happens at finish): any id overlap (or a
+            # post/void naming one) would mis-validate — refuse to
+            # dispatch ahead. Conservative on id_lo alone: false positives
+            # only cost the overlap, never correctness.
+            if bool(np.isin(events["id_lo"], pending["id_lo"]).any()) or bool(
+                np.isin(events["pending_id_lo"], pending["id_lo"]).any()
+            ):
+                return None
+        if bits & 4:
+            # Bloom maybe-hits: confirm against the durable index (reads
+            # the LSM — a GridReadFault here aborts the dispatch cleanly;
+            # nothing was mutated).
+            m = maybe_u8.astype(bool)
+            if self.transfer_index.contains_any(
+                pack_keys(events["id_lo"][m], events["id_hi"][m])
+            ):
+                return None
+        ts = np.uint64(timestamp) - np.uint64(n) + 1 + np.arange(n, dtype=np.uint64)
+        b, host_code_p = self._device_batch(events, ts, dr_slots, cr_slots, host_code)
+        with tracer.span("sm.ct.dispatch"):
+            new_state, codes_dev, bail_dev = self._ops.create_transfers_fast(
+                self.state, b, host_code_p
+            )
+        handle = {
+            "events": events, "ts": ts, "timestamp": timestamp, "n": n,
+            "codes": codes_dev, "bail": bail_dev,
+            "prev_state": self.state, "gen": self._state_gen,
+            "id_lo": events["id_lo"],
+        }
+        # Chain optimistically: batch N+1's kernel may consume this token
+        # before N's sync lands (the device orders the data dependency).
+        self.state = new_state
+        self._ct_pending.append(handle)
+        return handle
+
+    def create_transfers_finish(self, handle) -> np.ndarray:
+        """Sync + store the dispatched batch; byte-identical results to
+        the single-phase path (bail falls back to serial exactly as
+        _commit_fast_device does)."""
+        assert self._ct_pending and handle is self._ct_pending[0], (
+            "split-phase finish out of dispatch order"
+        )
+        self._ct_pending.pop(0)
+        events, timestamp, n = handle["events"], handle["timestamp"], handle["n"]
+        if handle["gen"] != self._state_gen:
+            # An earlier batch in the chain bailed and rolled the state
+            # token back: this kernel consumed a revoked token — discard
+            # and re-execute from the current (correct) state. The refire
+            # mutates state that any LATER outstanding handle's kernel
+            # did not observe, so fence those too (they will refire in
+            # turn at their own finish).
+            self._state_gen += 1
+            return self._create_transfers_impl(events, timestamp)
+        if bool(handle["bail"]):
+            self.state = handle["prev_state"]
+            self._state_gen += 1
+            self.stats["bail_batches"] += 1
+            return self._create_transfers_serial(events, timestamp)
+        self.stats["fast_batches"] += 1
+        ts = handle["ts"]
+        codes = np.asarray(handle["codes"])[:n]
+        ok = codes == 0
+        if np.any(ok):
+            recs = events[ok].copy()
+            recs["timestamp"] = ts[ok]
+            self._store_new_transfers(recs)
+            self.commit_timestamp = int(ts[ok][-1])
+        return _codes_to_results(codes)
+
+    def create_transfers_abandon(self, handle) -> None:
+        """Discard the NEWEST dispatched-but-unfinished handle (its op is
+        being requeued behind a grid repair): roll the state token back to
+        the pre-dispatch value and fence anything that chained off the
+        abandoned token."""
+        if not self._ct_pending or handle is not self._ct_pending[-1]:
+            return
+        self._ct_pending.pop()
+        if handle["gen"] == self._state_gen:
+            # A stale gen means an earlier bail already rolled the token
+            # back past this handle's base — restoring would clobber it.
+            self.state = handle["prev_state"]
+            self._state_gen += 1
 
     def _create_transfers_staged(
         self, events: np.ndarray, timestamp: int, staged

@@ -118,7 +118,7 @@ async def read_message(reader: asyncio.StreamReader) -> Optional[Message]:
         except (asyncio.IncompleteReadError, ConnectionError):
             return None
     msg = Message(h, body)
-    with tracer.span("bus.verify_body"):
+    with tracer.span("stage.parse"):
         ok = h.valid_checksum_body(body)
     return msg if ok else None
 
@@ -128,7 +128,9 @@ class ReplicaServer:
 
     TICK_SECONDS = 0.01
 
-    def __init__(self, replica, addresses: List[Tuple[str, int]]) -> None:
+    def __init__(
+        self, replica, addresses: List[Tuple[str, int]], overlap: bool = True
+    ) -> None:
         self.replica = replica
         self.addresses = addresses
         # Boot index: which address we LISTEN on (static). Protocol
@@ -140,6 +142,11 @@ class ReplicaServer:
         self.client_conns: Dict[int, _Conn] = {}
         self._server: Optional[asyncio.base_events.Server] = None
         self._stopping = asyncio.Event()
+        # Overlapped commit pipeline (docs/COMMIT_PIPELINE.md): WAL writer
+        # thread + commit-executor stage, wired at start(). overlap=False
+        # keeps the async WAL but commits serially on the event loop (the
+        # determinism-guard comparison runs both ways).
+        self.overlap = overlap
         replica.bus = self  # inject ourselves as the bus
 
     @property
@@ -179,13 +186,50 @@ class ReplicaServer:
 
     # --- lifecycle ------------------------------------------------------
 
+    # Stream buffer limit: the asyncio default (64 KiB) makes a 1 MiB
+    # prepare body cross ~16 pause/resume cycles of Python feed code per
+    # message — pure event-loop GIL time that now contends with the
+    # commit executor. 2 MiB lets a full message buffer in one gulp.
+    STREAM_LIMIT = 1 << 21
+
     async def start(self) -> None:
         host, port = self.addresses[self.me]
-        self._server = await asyncio.start_server(self._on_accept, host, port)
+        self._server = await asyncio.start_server(
+            self._on_accept, host, port, limit=self.STREAM_LIMIT
+        )
+        self._wire_stages()
         for r in range(len(self.addresses)):
             if r < self.me:
                 asyncio.ensure_future(self._connect_peer(r))
         asyncio.ensure_future(self._tick_loop())
+
+    def _wire_stages(self) -> None:
+        """Attach the off-loop pipeline stages: the WAL writer thread
+        (durable body writes; ack-after-durable) and, unless overlap is
+        disabled, the commit-executor stage. Both post completions back
+        through a fail-stop guard — a raised callback stops the server
+        loudly instead of wedging a half-applied replica."""
+        from tigerbeetle_tpu.vsr.journal import WalWriter
+
+        loop = asyncio.get_running_loop()
+
+        def _guarded(cb) -> None:
+            try:
+                cb()
+            except Exception:
+                log.error(
+                    "replica raised in a pipeline-stage callback — "
+                    "failing stop:\n%s", traceback.format_exc(),
+                )
+                self.stop()
+                raise
+
+        post = lambda cb: loop.call_soon_threadsafe(_guarded, cb)  # noqa: E731
+        if self.replica.wal_writer is None:
+            self.replica.wal_writer = WalWriter(self.replica.storage, post)
+            self.replica.journal.writer = self.replica.wal_writer
+        if self.overlap and self.replica.executor is None:
+            self.replica.attach_executor(post)
 
     async def serve_forever(self) -> None:
         if self._server is None:
@@ -196,6 +240,10 @@ class ReplicaServer:
         self._stopping.set()
         if self._server is not None:
             self._server.close()
+        if self.replica.executor is not None:
+            self.replica.executor.stop()
+        if self.replica.wal_writer is not None:
+            self.replica.wal_writer.stop()
 
     async def _tick_loop(self) -> None:
         while not self._stopping.is_set():
@@ -209,7 +257,9 @@ class ReplicaServer:
         host, port = self.addresses[r]
         while not self._stopping.is_set():
             try:
-                reader, writer = await asyncio.open_connection(host, port)
+                reader, writer = await asyncio.open_connection(
+                    host, port, limit=self.STREAM_LIMIT
+                )
             except OSError:
                 await asyncio.sleep(backoff)
                 backoff = min(backoff * 2, 2.0)

@@ -182,8 +182,20 @@ class Cluster:
         loss: float = 0.0,
         sm_backend: str = "numpy",
         standby_count: int = 0,
+        overlap: bool = False,
     ) -> None:
         self.cluster_id = 0xC1
+        # overlap=True attaches a real CommitExecutor thread to every
+        # replica (the overlapped commit stage, vsr/pipeline.py); its
+        # loop-side callbacks are drained by step(), standing in for the
+        # asyncio loop. Execution timing then depends on thread
+        # scheduling, but the COMMITTED chain must stay byte-identical to
+        # a serial run — the determinism guard in tests/test_cluster.py
+        # compares both ways.
+        self.overlap = overlap
+        from collections import deque
+
+        self._exec_posts = deque()
         self.replica_count = replica_count
         self.standby_count = standby_count
         self.config = config
@@ -224,6 +236,14 @@ class Cluster:
             on_event=self._on_replica_event,
         )
         r.open()
+        if self.overlap:
+            # Posts are tagged with their replica so step() can drop
+            # callbacks from an executor whose replica has since crashed
+            # or retired (a dead replica must not keep applying
+            # completions or sending through the live network).
+            r.attach_executor(
+                lambda cb, _r=r: self._exec_posts.append((_r, cb))
+            )
         self.replicas[i] = r
 
     def _on_replica_event(self, kind: str, r: Replica) -> None:
@@ -238,6 +258,8 @@ class Cluster:
             )
             if ix is not None:
                 self.replicas[ix] = None
+            if r.executor is not None:
+                r.executor.stop()
             return
         if kind != "promoted":
             return
@@ -275,6 +297,9 @@ class Cluster:
         exercising journal/superblock recovery classification."""
         self.net.crashed.add(("replica", i))
         self.storages[i].crash(torn_write_probability=torn_write_probability)
+        dead = self.replicas[i]
+        if dead is not None and dead.executor is not None:
+            dead.executor.stop()
         self.replicas[i] = None
 
     def restart_replica(self, i: int) -> None:
@@ -286,6 +311,24 @@ class Cluster:
     # --- scheduling -----------------------------------------------------
 
     def step(self) -> None:
+        # Apply commit-stage completions first (the asyncio-loop stand-in:
+        # call_soon_threadsafe callbacks run before the next socket read).
+        while True:
+            try:
+                r, cb = self._exec_posts.popleft()
+            except IndexError:
+                break
+            if r in self.replicas:  # replaced/crashed replicas are dropped
+                cb()
+        if self.overlap and any(
+            r is not None and r._staged for r in self.replicas
+        ):
+            # Yield the GIL so the executor threads actually run: the sim
+            # loop never blocks, and a starved stage would look like a
+            # glacial replica (client resend storms), not real behavior.
+            import time
+
+            time.sleep(0.0002)
         for dst, data in self.net.deliver_due():
             kind, ident = dst
             msg = Message.from_bytes(data)
@@ -313,6 +356,18 @@ class Cluster:
                 return
             self.step()
         raise TimeoutError(f"condition not reached in {max_ticks} ticks")
+
+    def quiesce(self) -> None:
+        """Drain every replica's commit stage and apply completions (the
+        checkers read commit_min / state-machine state)."""
+        for r in self.replicas:
+            if r is not None and r.executor is not None:
+                r._quiesce_commit_stage()
+
+    def close(self) -> None:
+        for r in self.replicas:
+            if r is not None and r.executor is not None:
+                r.executor.stop()
 
     # --- checkers -------------------------------------------------------
 

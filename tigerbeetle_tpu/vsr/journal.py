@@ -23,11 +23,15 @@ class WalWriter:
     """WAL durable-write thread (reference replica.zig:3034: replication
     overlaps the WAL write; acks wait for durability).
 
-    `submit(offset, chunks, cb)` queues a slot write; the thread performs
+    `submit(segments, cb)` queues a slot write — segments is a list of
+    `(offset, chunks, durable)`; durable segments go through
     `storage.write_durable` — an O_DIRECT|O_DSYNC pwrite on FileStorage,
-    durable at return, GIL released for the DMA — then posts `cb` to the
-    event loop. `barrier(cb)` posts `cb` once every previously queued
-    write is durable (duplicate-prepare re-acks). When the storage has no
+    durable at return, GIL released for the DMA — buffered segments (the
+    redundant header ring, which acks never wait for) through plain
+    `storage.write`, keeping even that pwrite's writeback stalls off the
+    event loop. `cb` is posted to the event loop after the entry's
+    writes. `barrier(cb)` posts `cb` once every previously queued write
+    is durable (duplicate-prepare re-acks). When the storage has no
     direct fd, the thread falls back to the group-commit shape: buffered
     writes for the whole popped batch, ONE fdatasync, then the callbacks.
 
@@ -42,7 +46,8 @@ class WalWriter:
         self._storage = storage
         self._post = post
         self._cond = threading.Condition()
-        # (offset, chunks, cb); offset None = barrier.
+        # (segments, cb); segments None = barrier, else a list of
+        # (offset, chunks, durable) writes performed in order.
         self._pending: List[tuple] = []
         self._busy = False  # an item is mid-write (for drain())
         self._stopped = False
@@ -51,14 +56,14 @@ class WalWriter:
         )
         self._thread.start()
 
-    def submit(self, offset: int, chunks, cb: Callable[[], None]) -> None:
+    def submit(self, segments, cb: Callable[[], None]) -> None:
         with self._cond:
-            self._pending.append((offset, chunks, cb))
+            self._pending.append((segments, cb))
             self._cond.notify_all()
 
     def barrier(self, cb: Callable[[], None]) -> None:
         with self._cond:
-            self._pending.append((None, None, cb))
+            self._pending.append((None, cb))
             self._cond.notify_all()
 
     def drain(self) -> None:
@@ -92,23 +97,28 @@ class WalWriter:
                 self._busy = True
             try:
                 if getattr(self._storage, "supports_direct", False):
-                    for offset, chunks, cb in batch:
-                        if offset is not None:
-                            self._storage.write_durable(offset, chunks)
+                    for segments, cb in batch:
+                        for offset, chunks, durable in segments or ():
+                            if durable:
+                                self._storage.write_durable(offset, chunks)
+                            else:
+                                pos = offset
+                                for c in chunks:
+                                    self._storage.write(pos, c)
+                                    pos += len(c)
                         self._post(cb)
                 else:
                     wrote = False
-                    for offset, chunks, _cb in batch:
-                        if offset is None:
-                            continue
-                        pos = offset
-                        for c in chunks:
-                            self._storage.write(pos, c)
-                            pos += len(c)
-                        wrote = True
+                    for segments, _cb in batch:
+                        for offset, chunks, _durable in segments or ():
+                            pos = offset
+                            for c in chunks:
+                                self._storage.write(pos, c)
+                                pos += len(c)
+                            wrote = True
                     if wrote:
                         self._storage.sync()
-                    for _off, _ch, cb in batch:
+                    for _segments, cb in batch:
                         self._post(cb)
             except Exception as e:  # noqa: BLE001 — fail-stop, never wedge
                 # A failed WAL write means acks can never be granted again:
@@ -171,11 +181,14 @@ class Journal:
         with tracer.span("journal.write_prepare"):
             self._write_prepare(message, sync)
 
-    def _slot_prologue(self, message: Message) -> tuple:
+    def _slot_prologue(self, message: Message, write_header_ring: bool = True) -> tuple:
         """Shared bookkeeping for BOTH write paths (sync and async): the
         two must stay bit-identical for recovery — asserts, header-ring
-        mirror, timestamp floor, dirty/faulty clearing. Returns
-        (slot, hraw, body base offset)."""
+        mirror, timestamp floor, dirty/faulty clearing. The async path
+        passes write_header_ring=False and queues that (buffered) write
+        on the writer thread instead, so a writeback-stalled pwrite can
+        never block the event loop. Returns (slot, hraw, body base
+        offset)."""
         assert message.header["command"] == Command.PREPARE
         op = message.header["op"]
         assert self.can_write(op), (
@@ -185,9 +198,10 @@ class Journal:
         slot = self.slot_for_op(op)
         hraw = message.header.to_bytes()
         assert HEADER_SIZE + len(message.body) <= self.message_size_max
-        self.storage.write(
-            self.zone.wal_headers_offset + slot * HEADER_SIZE, hraw
-        )
+        if write_header_ring:
+            self.storage.write(
+                self.zone.wal_headers_offset + slot * HEADER_SIZE, hraw
+            )
         self.headers[slot] = message.header.copy()
         self.timestamp_max = max(self.timestamp_max, int(message.header["timestamp"]))
         self.dirty.discard(slot)
@@ -219,16 +233,26 @@ class Journal:
         torn (classified `dirty`, ring rewritten), so acks need only the
         body durable."""
         assert self.writer is not None
-        slot, hraw, base = self._slot_prologue(message)
-        self.inflight[slot] = message
+        with tracer.span("stage.wal"):
+            slot, hraw, base = self._slot_prologue(message, write_header_ring=False)
+            self.inflight[slot] = message
 
-        def _done() -> None:
-            if self.inflight.get(slot) is message:
-                del self.inflight[slot]
-            on_durable()
+            def _done() -> None:
+                if self.inflight.get(slot) is message:
+                    del self.inflight[slot]
+                on_durable()
 
-        chunks = (hraw, message.body) if message.body else (hraw,)
-        self.writer.submit(base, chunks, _done)
+            chunks = (hraw, message.body) if message.body else (hraw,)
+            self.writer.submit(
+                [
+                    # Redundant header ring: buffered (acks never wait for
+                    # it — recovery treats the body as authoritative).
+                    (self.zone.wal_headers_offset + slot * HEADER_SIZE,
+                     (hraw,), False),
+                    (base, chunks, True),
+                ],
+                _done,
+            )
 
     def _drain_writer(self) -> None:
         if self.writer is not None:

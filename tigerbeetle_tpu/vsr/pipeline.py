@@ -1,0 +1,186 @@
+"""Overlapped commit stage: state-machine execution off the event loop.
+
+The serial replica commits inline — the asyncio event loop parses a
+request, writes the WAL, executes the state machine, stores, and only
+then reads the next socket. Under load that strictly alternates network
+and compute: the WAL writer thread idles while the loop executes, and
+sockets back up while the state machine posts balances.
+
+`CommitExecutor` mirrors the `WalWriter` shape (vsr/journal.py): one
+dedicated worker thread, a condition-variable queue, completions posted
+back to the event loop. The replica hands it COMMITTED prepares (commit
+order is fixed before anything is submitted — quorum on the primary, the
+commit number on backups) and the stage drains strictly in op order, so
+execution of op N overlaps the networking, WAL durability, and quorum
+accounting of ops N+1..N+k without perturbing determinism (the paper's
+core claim: the state machine is a pure function of (state, ordered
+batch)).
+
+Protocol with the replica (vsr/replica.py `_stage_*`), all on the worker
+thread:
+
+  - `process(job) -> (publish, leftovers, ok)`: execute one job. On
+    success the replica posts the job's completion itself via
+    `complete()` — EARLY, right after the reply is built and before the
+    op's deferred store/compaction beat, mirroring the serial path's
+    reply-first design. ok=False PARKS the stage on a `GridReadFault`;
+    `leftovers` are unexecuted jobs to push back to the queue head, and
+    `publish` (the faulted job, or a finish-fault marker for an op whose
+    completion already went out) is made visible only AFTER the park
+    flag is set, so the event loop's `reset()` cannot race it.
+  - `flush() -> (publish, ok)`: settle a held (double-buffered) job once
+    the queue runs dry.
+  - `complete(job)` appends to the thread-safe done deque and pokes the
+    event loop, which applies completions in op order via `pop_done()`.
+
+Fail-stop discipline matches WalWriter: any non-`GridReadFault`
+exception posts a poison callback so the event loop crashes loudly
+instead of wedging with a silently dead stage.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, List, Optional, Tuple
+
+# Max jobs popped per cycle (keeps park/reset bookkeeping bounded).
+RUN_MAX = 8
+
+
+class CommitExecutor:
+    def __init__(
+        self,
+        process: Callable[[dict], Tuple[Optional[dict], List[dict], bool]],
+        post: Callable[[Callable[[], None]], None],
+        flush: Optional[Callable[[], Tuple[Optional[dict], bool]]] = None,
+        notify: Optional[Callable[[], None]] = None,
+    ) -> None:
+        self._process = process
+        self._flush = flush
+        self._post = post
+        # Posted to the loop after completions land on the done deque —
+        # the replica's completion drainer (applies state in op order).
+        self._notify = notify if notify is not None else (lambda: None)
+        self._cond = threading.Condition()
+        self._pending: deque = deque()
+        self._done: deque = deque()
+        self._busy = False
+        self._parked = False
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._run, name="commit-executor", daemon=True
+        )
+        self._thread.start()
+
+    # --- event-loop side -------------------------------------------------
+
+    def submit(self, job: dict) -> None:
+        with self._cond:
+            self._pending.append(job)
+            self._cond.notify_all()
+
+    def pop_done(self) -> Optional[dict]:
+        """Next completed job, in completion (= op) order; None when empty.
+        Thread-safe: the worker appends, the event loop pops."""
+        try:
+            return self._done.popleft()
+        except IndexError:
+            return None
+
+    def drain(self) -> None:
+        """Block until every submitted job has been processed (including a
+        held double-buffered job) or the stage parked on a fault. Apply
+        completions via pop_done() after — drain orders EXECUTION, the
+        loop still owns state application."""
+        with self._cond:
+            while (self._pending or self._busy) and not self._parked:
+                if self._stopped:
+                    raise RuntimeError(
+                        "commit executor fail-stopped with jobs still queued"
+                    )
+                self._cond.wait()
+
+    def reset(self) -> List[dict]:
+        """Reclaim unprocessed jobs and unpark (grid-repair recovery: the
+        event loop re-derives the commit stream from the journal, so the
+        queue must not replay stale jobs)."""
+        with self._cond:
+            out = list(self._pending)
+            self._pending.clear()
+            self._parked = False
+            self._cond.notify_all()
+        return out
+
+    @property
+    def parked(self) -> bool:
+        return self._parked
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+
+    # --- worker-thread side ----------------------------------------------
+
+    def complete(self, job: dict) -> None:
+        """Publish one completion (called by `process` the moment an op's
+        reply is ready — before its deferred storage work)."""
+        self._done.append(job)
+        self._post(self._notify)
+
+    def _publish_parked(self, publish: Optional[dict], rest: List[dict]) -> None:
+        """Park and make the fault visible in ONE lock scope: any thread
+        that observes parked (drain / quiesce) must also find the fault
+        on the done deque, and the loop can only learn of the fault via
+        that deque — so its reset() always sees the fully parked state."""
+        with self._cond:
+            self._pending.extendleft(reversed(rest))
+            if publish is not None:
+                self._done.append(publish)
+            self._parked = True
+            self._cond.notify_all()
+        if publish is not None:
+            self._post(self._notify)
+
+    def _poison(self, err: BaseException) -> None:
+        def _raise() -> None:
+            raise RuntimeError(f"commit executor stage failed: {err!r}") from err
+
+        self._post(_raise)
+        with self._cond:
+            self._stopped = True
+            self._busy = False
+            self._cond.notify_all()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while (not self._pending or self._parked) and not self._stopped:
+                    self._cond.wait()
+                if self._stopped:
+                    return
+                run = [
+                    self._pending.popleft()
+                    for _ in range(min(RUN_MAX, len(self._pending)))
+                ]
+                self._busy = True
+            try:
+                for i, job in enumerate(run):
+                    publish, leftovers, ok = self._process(job)
+                    if not ok:
+                        self._publish_parked(publish, leftovers + run[i + 1 :])
+                        break
+                else:
+                    with self._cond:
+                        queue_empty = not self._pending
+                    if queue_empty and self._flush is not None:
+                        publish, ok = self._flush()
+                        if not ok:
+                            self._publish_parked(publish, [])
+            except Exception as e:  # noqa: BLE001 — fail-stop, never wedge
+                self._poison(e)
+                return
+            with self._cond:
+                self._busy = False
+                self._cond.notify_all()

@@ -62,10 +62,16 @@ REPAIR_TIMEOUT = 20
 
 
 def _parse_headers(body: bytes) -> List[Header]:
-    return [
-        Header.from_bytes(body[i * hdr.HEADER_SIZE : (i + 1) * hdr.HEADER_SIZE])
-        for i in range(len(body) // hdr.HEADER_SIZE)
-    ]
+    """One np.frombuffer over the whole body instead of a per-header
+    slice+copy loop: each Header wraps a record view of the single
+    (mutable) backing buffer."""
+    n = len(body) // hdr.HEADER_SIZE
+    if n == 0:
+        return []
+    recs = np.frombuffer(
+        bytearray(body[: n * hdr.HEADER_SIZE]), dtype=hdr.HEADER_DTYPE
+    )
+    return [Header(recs[i]) for i in range(n)]
 
 
 def _event_dtype(operation: int) -> np.dtype:
@@ -270,6 +276,20 @@ class Replica:
         # prepare (tests, simulator: deterministic single-thread
         # semantics).
         self.wal_writer = None
+        # Optional overlapped commit stage (vsr/pipeline.CommitExecutor,
+        # wired via attach_executor): committed prepares execute on a
+        # dedicated thread, strictly in op order, while the event loop
+        # keeps pumping sockets/prepare_oks/heartbeats. None = serial
+        # inline commits (tests, deterministic simulator).
+        self.executor = None
+        # Jobs handed to the stage but not yet completion-applied, in op
+        # order. commit_min advances only as completions are applied.
+        self._staged: List[dict] = []
+        # Executor-thread-owned: the job whose device kernel is dispatched
+        # but not yet synced (double-buffered device path).
+        self._stage_pending: Optional[dict] = None
+        self._stage_quiescing = False
+        self._reply_builder: Optional[hdr.ReplyBuilder] = None
 
     # ------------------------------------------------------------------
 
@@ -296,6 +316,12 @@ class Replica:
     @property
     def is_backup(self) -> bool:
         return self.status == STATUS_NORMAL and not self.is_primary
+
+    @property
+    def commit_staged(self) -> int:
+        """Highest op handed to the commit stage (== commit_min when the
+        stage is empty or the replica runs serial commits)."""
+        return self._staged[-1]["op"] if self._staged else self.commit_min
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -606,11 +632,17 @@ class Replica:
             if sess is None:
                 # Session is created when the register op COMMITS (it is
                 # replicated state — reference client_sessions.zig); guard
-                # against duplicate registers already in the pipeline.
+                # against duplicate registers already in the pipeline OR
+                # in the commit stage (committed, session not yet applied
+                # — a resend there would register the client twice).
                 if not any(
                     e.message.header["client"] == client
                     and e.message.header["operation"] == Operation.REGISTER
                     for e in self.pipeline
+                ) and not any(
+                    job["msg"].header["client"] == client
+                    and job["msg"].header["operation"] == Operation.REGISTER
+                    for job in self._staged
                 ):
                     self._append_request(msg)
             else:
@@ -646,6 +678,13 @@ class Replica:
         for queued in self.request_queue:
             qh = queued.header
             if qh["client"] == client and qh["request"] >= h["request"]:
+                return
+        # Same for ops in the commit stage: committed but not yet applied
+        # (sess.request still lags), so a resend here would prepare —
+        # and execute — the request a second time.
+        for job in self._staged:
+            jh = job["msg"].header
+            if jh["client"] == client and jh["request"] >= h["request"]:
                 return
         self._append_request(msg)
 
@@ -891,6 +930,11 @@ class Replica:
         total = self.replica_count + self.standby_count
         if total <= 1:
             return
+        with tracer.span("stage.replicate"):
+            self._replicate_chain_inner(prepare)
+
+    def _replicate_chain_inner(self, prepare: Message) -> None:
+        total = self.replica_count + self.standby_count
         if self.is_standby:
             # Standby sub-chain: forward to the next standby, if any.
             if self.replica + 1 < total:
@@ -938,14 +982,14 @@ class Replica:
             if len(entry.ok_from) < self.quorum_replication:
                 break
             op = entry.message.header["op"]
-            if op <= self.commit_min:
+            if op <= self.commit_staged:
                 # Already committed through the journal path (e.g. while a
                 # grid repair had the pipeline gated): drop the stale head
                 # — the client recovers its reply from the session cache
                 # on resend; executing again would double-apply.
                 self.pipeline.pop(0)
                 continue
-            if op != self.commit_min + 1:
+            if op != self.commit_staged + 1:
                 # Earlier ops (from before a view change) must commit through
                 # the journal first; _commit_journal re-checks the pipeline.
                 break
@@ -955,6 +999,15 @@ class Replica:
                 or self._checkpoint_pending
             ):
                 break  # a block repair is in flight: commits are gated
+            if self.executor is not None:
+                # Overlapped stage: hand the committed prepare to the
+                # executor (reply sent at completion) and keep pumping.
+                if not self._stage_can_submit():
+                    break
+                self.pipeline.pop(0)
+                self.commit_max = max(self.commit_max, op)
+                self._stage_submit(entry.message, op, entry)
+                continue
             self.pipeline.pop(0)
             self.commit_max = max(self.commit_max, op)
             try:
@@ -1061,29 +1114,280 @@ class Replica:
             or self._checkpoint_pending
         ):
             return  # a block repair is in flight: commits are gated
-        while self.commit_min < self.commit_max:
-            op = self.commit_min + 1
-            msg = self.journal.read_prepare(op) if self._journal_has_target(op) else None
-            if msg is None:
-                self._repair_gaps(target=op)
-                break
-            try:
-                self._execute(msg)
-            except GridReadFault as fault:
-                self._begin_grid_repair(fault)
-                break
-            self.commit_min += 1
-            self._drop_target(op)
-            try:
-                self._finish_commit()
-            except GridReadFault as fault:
-                self._finish_pending = True
-                self._begin_grid_repair(fault)
-                break
-            if not self._checkpoint_guarded():
-                break
+        if self.executor is not None:
+            # Overlapped stage: feed committable journal ops to the
+            # executor in op order; completions advance commit_min.
+            while self.commit_staged < self.commit_max and self._stage_can_submit():
+                op = self.commit_staged + 1
+                msg = (
+                    self.journal.read_prepare(op)
+                    if self._journal_has_target(op) else None
+                )
+                if msg is None:
+                    self._repair_gaps(target=op)
+                    break
+                self._stage_submit(msg, op, None)
+        else:
+            while self.commit_min < self.commit_max:
+                op = self.commit_min + 1
+                msg = self.journal.read_prepare(op) if self._journal_has_target(op) else None
+                if msg is None:
+                    self._repair_gaps(target=op)
+                    break
+                try:
+                    self._execute(msg)
+                except GridReadFault as fault:
+                    self._begin_grid_repair(fault)
+                    break
+                self.commit_min += 1
+                self._drop_target(op)
+                try:
+                    self._finish_commit()
+                except GridReadFault as fault:
+                    self._finish_pending = True
+                    self._begin_grid_repair(fault)
+                    break
+                if not self._checkpoint_guarded():
+                    break
         if self.is_primary and self.pipeline:
             self._check_pipeline_quorum()
+
+    # --- overlapped commit stage (vsr/pipeline.CommitExecutor) ----------
+    #
+    # Commit order is FIXED before anything is submitted (quorum on the
+    # primary, the commit number on backups); the stage drains strictly in
+    # that order, so execution overlaps networking/WAL/quorum accounting
+    # without perturbing determinism. Gated states (grid repair, block
+    # sync, checkpoint, view change, state sync) quiesce the stage before
+    # touching state the executor shares.
+
+    STAGE_QUEUE_MAX = 16  # ops in flight through the stage
+
+    def attach_executor(self, post: Callable[[Callable[[], None]], None]) -> None:
+        """Wire the overlapped commit stage. `post` schedules a callback
+        onto the replica's event loop thread (fail-stop guarded by the
+        embedder). Tests and the deterministic simulator never call this:
+        executor=None selects the serial inline fallback."""
+        from tigerbeetle_tpu.vsr.pipeline import CommitExecutor
+
+        assert self.executor is None
+        self._reply_builder = hdr.ReplyBuilder()
+        self.executor = CommitExecutor(
+            process=self._stage_process,
+            post=post,
+            flush=self._stage_flush,
+            notify=self._drain_stage_completions,
+        )
+
+    def _stage_can_submit(self) -> bool:
+        if self._stage_quiescing or len(self._staged) >= self.STAGE_QUEUE_MAX:
+            return False
+        # Checkpoint barrier: once a checkpoint-boundary op is staged,
+        # nothing may follow it until its completion ran the checkpoint on
+        # a quiescent state machine (the trailer must capture exactly the
+        # boundary op's state on every replica).
+        if self._staged and (
+            self._staged[-1]["op"] % self.config.checkpoint_interval == 0
+        ):
+            return False
+        return True
+
+    def _stage_submit(self, msg: Message, op: int, entry: Optional[Pipeline]) -> None:
+        assert op == self.commit_staged + 1
+        job = {"op": op, "msg": msg, "entry": entry}
+        self._staged.append(job)
+        self.executor.submit(job)
+
+    def _quiesce_commit_stage(self) -> None:
+        """Drain the stage and apply its completions inline — after this,
+        commit_min reflects every executed op and the executor is idle
+        (or parked on a fault, whose completion raises the gates)."""
+        if self.executor is None or not self._staged:
+            return
+        self._stage_quiescing = True
+        try:
+            while self._staged:
+                self.executor.drain()
+                self._drain_stage_completions()
+                if self.executor.parked:
+                    break  # fault: the gate flags take over from here
+        finally:
+            self._stage_quiescing = False
+
+    def _drain_stage_completions(self) -> None:
+        ex = self.executor
+        if ex is None:
+            return
+        while True:
+            job = ex.pop_done()
+            if job is None:
+                return
+            if "finish_fault" in job:
+                # The op committed (its completion was already applied);
+                # its deferred store/beat faulted after the fact and must
+                # complete after repair BEFORE any further op.
+                self._finish_pending = True
+                self._stage_reclaim(None, job["finish_fault"])
+                continue
+            self._stage_complete(job)
+
+    # -- executor-thread side (never touches loop-owned protocol state) --
+
+    def _stage_dispatch(self, job: dict):
+        """Double-buffered device dispatch: launch this batch's device
+        kernel BEFORE the previous batch's device→host sync. Returns a
+        state-machine handle, or None when the op cannot be dispatched
+        ahead (non-transfer op, routing depends on the outstanding batch,
+        host-only backend)."""
+        h = job["msg"].header
+        if h["operation"] != Operation.CREATE_TRANSFERS:
+            return None
+        events = np.frombuffer(job["msg"].body, dtype=types.TRANSFER_DTYPE)
+        return self.state_machine.create_transfers_dispatch(
+            events, int(h["timestamp"])
+        )
+
+    def _stage_process(self, job: dict):
+        """One stage step (executor thread): dispatch this op's device
+        work, then settle the held previous op (sync, store, reply,
+        compaction beat), then either hold this op (device path) or run
+        it in full. Returns (publish, leftovers, ok) for the executor;
+        ok=False parks the stage on a GridReadFault until the loop
+        repairs and resets."""
+        handle = None
+        try:
+            handle = self._stage_dispatch(job)
+        except GridReadFault:
+            # Dispatch is read-only: fall through to the full path, which
+            # will re-hit the fault at this op's proper turn.
+            handle = None
+        pend = self._stage_pending
+        if pend is not None:
+            self._stage_pending = None
+            publish, ok = self._stage_settle(pend, self._stage_exec_held)
+            if not ok:
+                if handle is not None:
+                    self.state_machine.create_transfers_abandon(handle)
+                # This job never executed: back to the queue head.
+                return publish, [job], False
+        if handle is not None:
+            job["_handle"] = handle
+            self._stage_pending = job
+            return None, [], True
+        publish, ok = self._stage_settle(job, self._stage_exec_full)
+        return publish, [], ok
+
+    def _stage_flush(self):
+        """Queue ran dry: settle the held double-buffered job."""
+        pend = self._stage_pending
+        if pend is None:
+            return None, True
+        self._stage_pending = None
+        return self._stage_settle(pend, self._stage_exec_held)
+
+    def _stage_exec_full(self, job: dict) -> None:
+        job["spec"] = self._execute(job["msg"], build_reply=False)
+
+    def _stage_exec_held(self, job: dict) -> None:
+        """Settle a dispatched op: device sync + store + reply spec, in
+        the identical per-op order as the serial path."""
+        msg = job["msg"]
+        h = msg.header
+        if self.aof is not None:
+            self.aof.append(msg, self.primary_index(h["view"]), self.replica)
+        sm = self.state_machine
+        with tracer.span("replica.execute"):
+            results = sm.create_transfers_finish(job.pop("_handle")).tobytes()
+            sm.prepare_timestamp = max(sm.prepare_timestamp, int(h["timestamp"]))
+            job["spec"] = self._execute_tail(msg, results, build_reply=False)
+
+    def _stage_settle(self, job: dict, run_exec) -> tuple:
+        """Execute one op and publish its completion EARLY — the reply is
+        built (through the preallocated scratch) and posted BEFORE the
+        op's deferred store/compaction beat, mirroring the serial path's
+        reply-first design — then run _finish_commit. Checkpoint-boundary
+        ops publish only after their finish, so the loop's checkpoint
+        always sees a quiescent state machine. Returns (publish, ok)."""
+        boundary = job["op"] % self.config.checkpoint_interval == 0
+        try:
+            run_exec(job)
+            job["committed"] = True
+        except GridReadFault as fault:
+            job["fault"] = fault
+            return job, False  # execute-phase fault: not committed
+        self._stage_emit(job)
+        if not boundary:
+            self.executor.complete(job)
+        try:
+            self._finish_commit()
+        except GridReadFault as fault:
+            if boundary:
+                job["fault"] = fault
+                return job, False  # completion carries the finish fault
+            # Completion already out: publish a finish-fault marker.
+            return {"op": job["op"], "finish_fault": fault}, False
+        if boundary:
+            self.executor.complete(job)
+        return None, True
+
+    def _stage_emit(self, job: dict) -> None:
+        """Build the op's reply through the preallocated scratch builder
+        and install it in the (replicated) client-session cache."""
+        spec = job.get("spec")
+        if spec is None:
+            return
+        with tracer.span("stage.reply"):
+            reply = self._reply_builder.build_one(spec)
+        job["reply"] = reply
+        sess = self.clients.get(spec["client"])
+        if sess is not None and sess.request == spec["request"]:
+            sess.reply = reply
+
+    # -- loop side: completion application -------------------------------
+
+    def _stage_complete(self, job: dict) -> None:
+        if not self._staged or self._staged[0] is not job:
+            return  # stale completion from a reset stage
+        self._staged.pop(0)
+        op = job["op"]
+        fault = job.get("fault")
+        if fault is not None and not job.get("committed"):
+            # Execute-phase fault: the op did NOT commit; requeue it (and
+            # everything staged behind it) and repair the block.
+            self._stage_reclaim(job, fault)
+            return
+        self.commit_min = op
+        self._drop_target(op)
+        spec = job.get("spec")
+        reply = job.get("reply")
+        if job.get("entry") is not None and reply is not None:
+            # Reply as soon as the completion lands — asyncio pushes it to
+            # the socket while the executor already works on later ops.
+            self.bus.send_to_client(spec["client"], reply)
+        if fault is not None:
+            # Finish-phase fault: committed, but the op's deferred
+            # store/beat must complete after repair BEFORE any further op.
+            self._finish_pending = True
+            self._stage_reclaim(None, fault)
+            return
+        if not self._checkpoint_guarded():
+            return
+        self._commit_journal(self.commit_max)
+
+    def _stage_reclaim(self, faulted_job: Optional[dict], fault: GridReadFault) -> None:
+        """A fault parked the stage: reclaim every unexecuted job, put
+        pipeline-origin entries back at the pipeline head (their replies
+        must still be delivered on retry), and start the grid repair —
+        the journal re-derives journal-origin ops after repair."""
+        pending = self._staged
+        self._staged = []
+        if self.executor is not None:
+            self.executor.reset()
+        jobs = ([faulted_job] if faulted_job is not None else []) + pending
+        entries = [j["entry"] for j in jobs if j.get("entry") is not None]
+        for e in reversed(entries):
+            self.pipeline.insert(0, e)
+        self._begin_grid_repair(fault)
 
     # --- repair ---------------------------------------------------------
 
@@ -1257,6 +1561,7 @@ class Replica:
         cached = self._sync_serve_cache
         if cached is not None and cached[0] == st.op_checkpoint:
             return cached
+        self._quiesce_commit_stage()  # trailer blocks are grid reads
         try:
             blob = self._trailer_read(st.trailer_block)
         except IOError:
@@ -1409,6 +1714,9 @@ class Replica:
         # ident) must neither crash the replica loop nor destroy state.
         if not snapshot.validate(blob):
             return
+        # The install replaces the state machine wholesale: the executor
+        # must not be mid-op against the old one.
+        self._quiesce_commit_stage()
         # A state sync supersedes any in-flight normal-operation grid
         # repair: the installed checkpoint replaces the state the faulted
         # op would have produced, so the repair gates (and any half-done
@@ -1556,6 +1864,9 @@ class Replica:
         verifies each payload against its wanted checksum, so serving a
         since-reused block is harmless (re-requested elsewhere)."""
         peer = msg.header["replica"]
+        # Serving reads the grid the executor may be compacting into —
+        # settle the stage first (cheap when the stage is empty).
+        self._quiesce_commit_stage()
         indices = np.frombuffer(msg.body, dtype=np.uint32)
         grid = self.state_machine.grid
         for b in indices[: self.BLOCKS_PER_REQUEST]:
@@ -1778,6 +2089,9 @@ class Replica:
         """Enter view_change for new_view (SVC quorum observed, or a DVC/SV
         for the view proves one existed)."""
         assert new_view > self.view or self.status != STATUS_NORMAL
+        # Leaving normal status: the commit stage must be empty — its ops
+        # are committed and the DVC below advertises commit_min.
+        self._quiesce_commit_stage()
         if self.status == STATUS_NORMAL:
             self.log_view = self.view
         log.info("replica %d: view_change -> view %d", self.replica, new_view)
@@ -1988,7 +2302,8 @@ class Replica:
         start_view; replica.zig pipeline reconstruction). Re-entrant: called
         again whenever a repaired prepare fills a gap."""
         in_pipe = {e.message.header["op"] for e in self.pipeline}
-        for op in range(self.commit_min + 1, self.op + 1):
+        # Staged ops are committed-in-flight: never re-propose them.
+        for op in range(self.commit_staged + 1, self.op + 1):
             if op in in_pipe:
                 continue
             msg = self.journal.read_prepare(op) if self._journal_has_target(op) else None
@@ -2029,6 +2344,10 @@ class Replica:
         v = h["view"]
         if v < self.view or (v == self.view and self.status == STATUS_NORMAL):
             return
+        # Adopting a new view truncates/overwrites journal state the
+        # staged ops were read from: drain execution first (they are
+        # committed — at or below the new view's commit floor).
+        self._quiesce_commit_stage()
         self.view = v
         self.log_view = v
         self.status = STATUS_NORMAL
@@ -2080,7 +2399,13 @@ class Replica:
         rt = self.clock.realtime_synchronized()
         return rt if rt is not None else self.time.realtime_ns()
 
-    def _execute(self, prepare: Message, replay: bool = False) -> Optional[Message]:
+    def _execute(
+        self, prepare: Message, replay: bool = False, build_reply: bool = True
+    ):
+        """Execute one committed prepare. build_reply=False (overlapped
+        stage) returns a reply SPEC dict instead of a sealed Message —
+        the stage serializes it through the preallocated scratch builder
+        (_stage_emit)."""
         if self.aof is not None:
             # Replay included: ops whose AOF entries died in the page cache
             # (power loss after commit) are re-offered by WAL replay and
@@ -2089,13 +2414,16 @@ class Replica:
                 prepare, self.primary_index(prepare.header["view"]), self.replica
             )
         with tracer.span("replica.execute"):
-            reply = self._execute_inner(prepare, replay)
+            results = self._execute_op(prepare)
+            out = self._execute_tail(
+                prepare, results, replay=replay, build_reply=build_reply
+            )
         if replay:
             # Replay has no reply to race ahead of: finish the op's apply
             # sequence inline (live commit paths call _finish_commit after
             # the reply send — same per-op order either way).
             self._finish_commit()
-        return reply
+        return out
 
     def _checkpoint_guarded(self) -> bool:
         """_maybe_checkpoint with grid-repair handling: the trailer write
@@ -2123,7 +2451,9 @@ class Replica:
         sm.flush_deferred()
         sm.compact_beat()
 
-    def _execute_inner(self, prepare: Message, replay: bool = False) -> Optional[Message]:
+    def _execute_op(self, prepare: Message) -> bytes:
+        """State-machine dispatch for one committed prepare → result
+        bytes (the reply body)."""
         h = prepare.header
         op_num = h["op"]
         operation = h["operation"]
@@ -2229,7 +2559,22 @@ class Replica:
                         self.on_event("retired", self)
         else:
             results = b""  # register / root
+        return results
 
+    def _execute_tail(
+        self,
+        prepare: Message,
+        results: bytes,
+        replay: bool = False,
+        build_reply: bool = True,
+    ):
+        """Post-execution bookkeeping + reply: commit checksum chain,
+        client-session (replicated) state, and the reply itself — built
+        inline on the serial path, returned as a spec dict for the
+        overlapped stage's coalesced builder when build_reply=False."""
+        h = prepare.header
+        op_num = h["op"]
+        operation = h["operation"]
         # State hash per op: (op, committed BODY checksum, results). The
         # body checksum is view-independent (re-proposed prepares reseal
         # the header but not the body), so replicas committing DIFFERENT
@@ -2255,14 +2600,25 @@ class Replica:
         # at commit (reference client_sessions.zig + commit_op :3777-3815).
         client = h["client"]
         reply: Optional[Message] = None
+        spec: Optional[dict] = None
         if client != 0:
-            rh = hdr.make(
-                Command.REPLY, self.cluster,
-                view=self.view, op=op_num, commit=op_num,
-                timestamp=h["timestamp"], client=client, request=h["request"],
-                replica=self.replica, operation=operation,
-            )
-            reply = Message(rh, results).seal()
+            if build_reply:
+                with tracer.span("stage.reply"):
+                    rh = hdr.make(
+                        Command.REPLY, self.cluster,
+                        view=self.view, op=op_num, commit=op_num,
+                        timestamp=h["timestamp"], client=client, request=h["request"],
+                        replica=self.replica, operation=operation,
+                    )
+                    reply = Message(rh, results).seal()
+            else:
+                spec = {
+                    "view": self.view, "op": op_num,
+                    "timestamp": int(h["timestamp"]), "client": client,
+                    "request": int(h["request"]), "replica": self.replica,
+                    "operation": operation, "cluster": self.cluster,
+                    "body": results,
+                }
             if operation == Operation.REGISTER:
                 if len(self.clients) >= self.config.clients_max:
                     self._evict_oldest_client()
@@ -2270,10 +2626,14 @@ class Replica:
             sess = self.clients.get(client)
             if sess is not None:
                 sess.request = h["request"]
+                # build_reply=False: _stage_emit fills this in right after
+                # this tail returns; a resend in the window simply gets
+                # nothing (indistinguishable from reply loss — the client
+                # retries).
                 sess.reply = reply
         if replay:
             return None
-        return reply
+        return reply if build_reply else spec
 
     def _get_account_transfers(self, f: np.void) -> np.ndarray:
         return self.state_machine.get_account_transfers(

@@ -1,0 +1,156 @@
+"""Bench regression gate: compare a fresh `bench.py` end_to_end block
+against the latest recorded round benchmark (BENCH_r*.json) and fail on
+a >10% regression in accepted throughput or client-perceived p50.
+
+Usage:
+    python bench.py | tee /tmp/bench.json
+    python tools/bench_gate.py /tmp/bench.json         # file with the JSON line
+    python bench.py | python tools/bench_gate.py -     # stdin
+    python tools/bench_gate.py --current-json '<json>' # inline
+
+Exit codes: 0 pass, 1 regression, 2 usage/missing-data. Every gate run
+appends a record to devhub.jsonl so the pass/fail history rides the same
+series as the bench numbers (reference devhub.zig:36-52).
+
+The e2e bar this repo is chasing (ROADMAP.md open items): end_to_end
+load_accepted_tx_per_s ≥ 1,000,000 and perceived_p50_ms ≤ 10 — the gate
+stops REGRESSIONS on the way there; it does not assert the bar itself.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# >10% worse than the recorded round fails the gate.
+THROUGHPUT_REGRESSION = 0.10
+LATENCY_REGRESSION = 0.10
+
+GATED = (
+    # (key, higher_is_better)
+    ("load_accepted_tx_per_s", True),
+    ("perceived_p50_ms", False),
+)
+
+
+def latest_round_e2e() -> tuple:
+    """(round, end_to_end block) from the newest BENCH_r*.json."""
+    rounds = []
+    for path in glob.glob(os.path.join(REPO, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if m:
+            rounds.append((int(m.group(1)), path))
+    if not rounds:
+        return 0, None
+    n, path = max(rounds)
+    with open(path) as f:
+        rec = json.load(f)
+    parsed = rec.get("parsed") or rec  # raw bench JSON also accepted
+    e2e = (parsed.get("extra") or {}).get("end_to_end")
+    if e2e is None or "load_accepted_tx_per_s" not in e2e:
+        return n, None
+    return n, e2e
+
+
+def extract_e2e(text: str):
+    """Pull the end_to_end block out of bench.py's output (the JSON line
+    may be surrounded by warnings/log noise)."""
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        e2e = (rec.get("extra") or {}).get("end_to_end")
+        if e2e is None and "load_accepted_tx_per_s" in rec:
+            e2e = rec  # a bare end_to_end block is fine too
+        if e2e is not None and "load_accepted_tx_per_s" in e2e:
+            return e2e
+    return None
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="bench_gate")
+    p.add_argument("current", nargs="?", default="-",
+                   help="file holding bench.py's JSON output ('-' = stdin)")
+    p.add_argument("--current-json", default=None,
+                   help="bench JSON passed inline instead of a file")
+    p.add_argument("--devhub", default=os.path.join(REPO, "devhub.jsonl"),
+                   help="series file to append the gate record to")
+    args = p.parse_args(argv)
+
+    if args.current_json is not None:
+        text = args.current_json
+    elif args.current == "-":
+        text = sys.stdin.read()
+    else:
+        with open(args.current) as f:
+            text = f.read()
+    current = extract_e2e(text)
+    if current is None:
+        print("bench_gate: no end_to_end block in the input", file=sys.stderr)
+        return 2
+    rnd, baseline = latest_round_e2e()
+    if baseline is None:
+        print("bench_gate: no BENCH_r*.json baseline found — recording only")
+
+    failed = []
+    rows = []
+    for key, higher_better in GATED:
+        cur = float(current[key])
+        base = float(baseline[key]) if baseline and key in baseline else None
+        verdict = "n/a"
+        if base is not None and base > 0:
+            if higher_better:
+                limit = base * (1.0 - THROUGHPUT_REGRESSION)
+                ok = cur >= limit
+            else:
+                limit = base * (1.0 + LATENCY_REGRESSION)
+                ok = cur <= limit
+            verdict = "ok" if ok else "REGRESSION"
+            if not ok:
+                failed.append(key)
+        rows.append((key, cur, base, verdict))
+
+    width = max(len(k) for k, *_ in rows)
+    print(f"bench gate vs BENCH_r{rnd:02d}.json (>10% regression fails):")
+    for key, cur, base, verdict in rows:
+        base_s = f"{base:,.1f}" if base is not None else "—"
+        print(f"  {key:{width}s}  current={cur:,.1f}  baseline={base_s}  {verdict}")
+
+    try:
+        from tigerbeetle_tpu import tracer
+
+        tracer.devhub_append(args.devhub, {
+            "metric": "bench_gate",
+            "value": len(failed),
+            "unit": "fail_count",
+            "extra": {
+                "baseline_round": rnd,
+                "current": {k: current.get(k) for k, _ in GATED},
+                "baseline": (
+                    {k: baseline.get(k) for k, _ in GATED} if baseline else None
+                ),
+                "failed": failed,
+            },
+        })
+    except OSError:
+        pass
+    if failed:
+        print(f"bench_gate: FAIL ({', '.join(failed)})", file=sys.stderr)
+        return 1
+    print("bench_gate: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, REPO)
+    sys.exit(main())
