@@ -33,7 +33,7 @@ class DummyBus:
         self.replies.append(msg)
 
 
-def main(backend="numpy", batches=40):
+def main(backend="numpy", batches=40, store_async=True):
     tracer.enable()
     tmp = tempfile.mkdtemp(prefix="tbtpu-prof-")
     path = os.path.join(tmp, "prof.tigerbeetle")
@@ -53,6 +53,17 @@ def main(backend="numpy", batches=40):
         zone=zone, config=config, bus=bus, sm_backend=backend,
     )
     replica.open()
+
+    # Async store stage (vsr/pipeline.StoreExecutor): store jobs + beats
+    # run off the commit path; loop-side posts (fault notifications) are
+    # drained between messages, standing in for the asyncio loop.
+    posts = []
+    if store_async:
+        replica.attach_store_executor(posts.append)
+
+    def pump_posts():
+        while posts:
+            posts.pop(0)()
 
     client_id = 0x1234567
     reqno = 0
@@ -112,14 +123,25 @@ def main(backend="numpy", batches=40):
         with tracer.span("stage.parse"):
             assert m.header.valid_checksum_body(m.body)
         replica.on_message(m)
+        pump_posts()
     total_s = time.perf_counter() - t0
+    # Replies are all out; the async store stage may still be draining the
+    # tail of its queue — settle it and report the lag separately.
+    drain_s = 0.0
+    if replica.store_executor is not None:
+        t0d = time.perf_counter()
+        replica.store_executor.drain()
+        drain_s = time.perf_counter() - t0d
+        pump_posts()
     assert len(bus.replies) - n0 == batches, (len(bus.replies) - n0, batches)
 
-    print(f"backend={backend} batches={batches}")
+    print(f"backend={backend} batches={batches} store_async={store_async}")
     print(f"client marshal: {marshal_s / batches * 1e3:.2f} ms/batch")
     print(f"client seal:    {seal_s / batches * 1e3:.2f} ms/batch")
     print(f"server total:   {total_s / batches * 1e3:.2f} ms/batch "
           f"({batches * BATCH / total_s / 1e6:.2f}M tx/s)")
+    if store_async:
+        print(f"store drain tail after last reply: {drain_s * 1e3:.2f} ms")
     snap = tracer.snapshot()
     for ev, rec in snap.items():
         print(f"  {ev:40s} count={rec['count']:5d} total_ms={rec['total_ms']:9.1f} "
@@ -127,43 +149,82 @@ def main(backend="numpy", batches=40):
 
     # Stage-attribution table (docs/COMMIT_PIPELINE.md stages): where the
     # per-batch milliseconds live, so the next round can see what is left
-    # on the commit path after the overlapped pipeline.
+    # on the commit path. The store stage is split into its sub-spans
+    # (object log / id index / account index / query index / compaction
+    # beats); with the async store stage those run on the store thread
+    # and are reported in their own section — the commit path then shows
+    # only barrier waits (store.wait).
     stages = {
         "parse": ("stage.parse",),
         "wal": ("journal.write_prepare", "stage.wal"),
         "replicate": ("stage.replicate",),
         "execute": ("replica.execute",),
-        "store": ("sm.ct.store",),  # deferred store, runs in _finish_commit
         "reply": ("stage.reply",),
     }
+    store_rows = {
+        "store.log": ("sm.store.log",),
+        "store.idx": ("sm.store.idx",),
+        "store.rows": ("sm.store.rows",),
+        "store.query": ("sm.store.query",),
+        "beat": ("sm.beat",),
+    }
+    if store_async:
+        stages["store.wait"] = ("sm.store.barrier",)
+    else:
+        stages.update(store_rows)
+
+    def span_ms(keys):
+        return sum(snap[k]["total_ms"] for k in keys if k in snap)
+
     total_ms = total_s * 1e3
     print("\nstage attribution (per batch, % of server total):")
     record = {}
     attributed = 0.0
     reply_ms = snap.get("stage.reply", {}).get("total_ms", 0.0)
     for stage, keys in stages.items():
-        ms = sum(snap[k]["total_ms"] for k in keys if k in snap)
+        ms = span_ms(keys)
         if stage == "execute":
-            # The serial path builds the reply inside the execute span;
-            # report the stages disjointly.
-            ms -= reply_ms
+            # The serial path builds the reply (and any barrier wait)
+            # inside the execute span; report the stages disjointly.
+            ms -= reply_ms + span_ms(("sm.store.barrier",)) * store_async
         attributed += ms
         record[stage] = round(ms / batches, 3)
-        print(f"  {stage:10s} {ms / batches:8.2f} ms/batch  {100 * ms / total_ms:5.1f}%")
+        print(f"  {stage:11s} {ms / batches:8.2f} ms/batch  {100 * ms / total_ms:5.1f}%")
     other = total_ms - attributed
     record["other"] = round(other / batches, 3)
-    print(f"  {'other':10s} {other / batches:8.2f} ms/batch  {100 * other / total_ms:5.1f}%")
+    print(f"  {'other':11s} {other / batches:8.2f} ms/batch  {100 * other / total_ms:5.1f}%")
+    if store_async:
+        # Off-path work: sub-span table of the async store stage (ms per
+        # batch of STORE-THREAD time; overlaps the commit path above).
+        async_ms = span_ms(("stage.store_async",))
+        print(f"\nasync store stage (off the commit path, "
+              f"{async_ms / batches:.2f} ms/batch total):")
+        for stage, keys in store_rows.items():
+            ms = span_ms(keys)
+            record[f"async.{stage}"] = round(ms / batches, 3)
+            print(f"  {stage:11s} {ms / batches:8.2f} ms/batch")
+        record["async.total"] = round(async_ms / batches, 3)
     tracer.devhub_append(
         os.path.join(os.path.dirname(os.path.abspath(__file__)), "devhub.jsonl"),
         {
             "metric": "e2e_stage_profile_ms_per_batch",
             "value": round(total_s / batches * 1e3, 3),
             "unit": "ms/batch",
-            "extra": {"backend": backend, "batches": batches, "stages": record},
+            "extra": {
+                "backend": backend, "batches": batches,
+                "store_async": store_async, "stages": record,
+            },
         },
     )
     storage.close()
 
 
 if __name__ == "__main__":
-    main(backend=sys.argv[1] if len(sys.argv) > 1 else "numpy")
+    _args = sys.argv[1:]
+    main(
+        backend=next(
+            (a for a in _args if a not in ("serial-store", "async-store")),
+            "numpy",
+        ),
+        store_async="serial-store" not in _args,
+    )

@@ -452,11 +452,13 @@ class TestOverlappedPipeline:
 
     OPS = 40  # past two TEST_MIN checkpoint intervals (16)
 
-    def _drive(self, overlap: bool, hash_log=None):
+    def _drive(self, overlap: bool, hash_log=None, store_async: bool = False):
         from tigerbeetle_tpu.testing.hash_log import attach_to_cluster
         from tigerbeetle_tpu.vsr.clock import Clock, DeterministicTime
 
-        cl = Cluster(replica_count=3, seed=9, overlap=overlap)
+        cl = Cluster(
+            replica_count=3, seed=9, overlap=overlap, store_async=store_async
+        )
         # Freeze wall time (tick_ns=0): prepare timestamps then derive
         # from the op stream alone, so the two runs' committed BYTES can
         # be compared even though reply latency (and so request arrival
@@ -485,42 +487,197 @@ class TestOverlappedPipeline:
                 assert all(
                     r.executor is not None for r in cl.replicas if r is not None
                 )
+            if store_async:
+                assert all(
+                    r.store_executor is not None
+                    for r in cl.replicas if r is not None
+                )
             chains = [
                 dict(r.commit_checksums) for r in cl.replicas if r is not None
             ]
-            checkpoints = {
-                r.replica: r.superblock.state.op_checkpoint
-                for r in cl.replicas if r is not None
-            }
-            digests = {
-                r.replica: Cluster._section_digests(Cluster._trailer_sections(r))
-                for r in cl.replicas if r is not None
-            }
-            assert cl.check_state_convergence() >= self.OPS
+            floors = [
+                r.checksum_floor for r in cl.replicas if r is not None
+            ]
+            assert cl.check_state_convergence() > 0
             assert cl.check_storage_convergence() >= 16
-            return chains, checkpoints, digests
+            # Per-op checkpoint section digests recorded as each boundary
+            # was first reached (the cross-run storage-determinism
+            # fingerprint — robust to a replica standing at an older or
+            # newer checkpoint when the run ends).
+            return chains, floors, dict(cl._checkpoint_history)
         finally:
             cl.close()
+
+    def _check_runs_identical(self, serial, *others):
+        """Cross-run determinism: every commit checksum recorded by any
+        replica of any run must agree op-for-op, and every checkpoint's
+        trailer section digests must match across runs. Chain COVERAGE is
+        allowed to be ragged — a backup can stand one or two ops behind
+        at capture time, and a scheduler-starved replica may even have
+        block/state-synced past old ops (suffix chain, checksum_floor >
+        0) — but at least one replica per run must carry the complete
+        unbroken chain of the whole workload."""
+        want = self.OPS + 2  # register + create_accounts + the transfers
+        runs = (serial, *others)
+        ref: dict = {}
+        for chains, _floors, _hist in runs:
+            for c in chains:
+                for op, v in c.items():
+                    assert ref.setdefault(op, v) == v, (
+                        f"divergent commit checksum at op {op}"
+                    )
+        for chains, floors, _hist in runs:
+            assert any(
+                f == 0 and len(c) == max(c) >= want
+                for c, f in zip(chains, floors)
+            ), "no replica carried the complete chain"
+        s_hist = serial[2]
+        for _chains, _floors, hist in others:
+            common = set(s_hist) & set(hist)
+            assert common and max(common) >= 16
+            for op in common:
+                assert s_hist[op] == hist[op], (
+                    f"checkpoint {op}: trailer bytes differ across runs"
+                )
 
     def test_overlap_vs_serial_hash_log_and_storage_identical(self, tmp_path):
         from tigerbeetle_tpu.testing.hash_log import HashLog
 
         path = str(tmp_path / "hash.log")
         create = HashLog(path, "create")
-        serial_chains, serial_cp, serial_digests = self._drive(
-            overlap=False, hash_log=create
-        )
+        serial = self._drive(overlap=False, hash_log=create)
         create.close()
         # The overlapped run CHECKS the serial run's hash log: the first
         # divergent commit checksum fails at its source op.
         check = HashLog(path, "check")
-        overlap_chains, overlap_cp, overlap_digests = self._drive(
-            overlap=True, hash_log=check
+        overlap = self._drive(overlap=True, hash_log=check)
+        check.close()
+        self._check_runs_identical(serial, overlap)
+
+
+class TestAsyncStoreStage:
+    """Guards for the async LSM store stage (vsr/pipeline.StoreExecutor):
+    determinism vs the serial store, read-your-writes over queued store
+    jobs, and the checkpoint drain with jobs + beats queued behind the
+    boundary op."""
+
+    def test_store_async_vs_serial_hash_log_and_storage_identical(self, tmp_path):
+        """Byte-identical hash_log commit chains and checkpoint trailer
+        digests for the same workload through (a) the serial store, (b)
+        the async store stage, and (c) the full production pipeline
+        (commit executor + store stage). Store timing moves off the
+        commit path; the committed chain and the durable bytes must
+        not."""
+        from tigerbeetle_tpu.testing.hash_log import HashLog
+
+        driver = TestOverlappedPipeline()
+        path = str(tmp_path / "hash.log")
+        create = HashLog(path, "create")
+        serial = driver._drive(overlap=False, hash_log=create)
+        create.close()
+        check = HashLog(path, "check")
+        store_async = driver._drive(
+            overlap=False, store_async=True, hash_log=check
         )
         check.close()
-        assert serial_chains == overlap_chains
-        assert serial_cp == overlap_cp and all(v >= 16 for v in serial_cp.values())
-        assert serial_digests == overlap_digests
+        check2 = HashLog(path, "check")
+        combined = driver._drive(overlap=True, store_async=True, hash_log=check2)
+        check2.close()
+        driver._check_runs_identical(serial, store_async, combined)
+
+    def test_read_your_writes_with_store_jobs_queued(self):
+        """Reads racing queued store writes: the reply for a create is
+        posted while its store job is still queued; a duplicate id in the
+        NEXT batch must be caught via the pending write buffer, and a
+        lookup must drain the stage (store_barrier) before answering.
+        The store worker is frozen by holding the stage's condition (an
+        RLock — the sim thread can still submit); any barrier's wait()
+        releases it, letting the worker catch up exactly when the serial
+        semantics require it."""
+        from tigerbeetle_tpu.results import CreateTransferResult as TR
+
+        cl = Cluster(replica_count=1, seed=5, store_async=True)
+        try:
+            c = setup_client(cl)
+            do_request(cl, c, Operation.CREATE_ACCOUNTS, account_batch([1, 2]))
+            se = cl.replicas[0].store_executor
+            with se._cond:  # freeze the worker's queue pop
+                r = do_request(cl, c, Operation.CREATE_TRANSFERS, transfer_batch([
+                    dict(id=10 + k, debit_account_id=1, credit_account_id=2,
+                         amount=5, ledger=1, code=1)
+                    for k in range(3)
+                ]))
+                assert len(parse_results(r)) == 0  # all accepted, reply out
+                # The writes are still queued (worker frozen): reply
+                # preceded store durability.
+                assert se.unapplied_stores(), "store job must still be queued"
+                # Next batch re-creates id 11 while its store is queued:
+                # the duplicate confirm must find it in the pending write
+                # buffer (the worker cannot have applied it).
+                r = do_request(cl, c, Operation.CREATE_TRANSFERS, transfer_batch([
+                    dict(id=11, debit_account_id=1, credit_account_id=2,
+                         amount=5, ledger=1, code=1)
+                ]))
+                res = parse_results(r)
+                assert len(res) == 1 and res[0]["result"] == int(TR.EXISTS)
+                # Lookup with the stage still attached: the op's
+                # store_barrier drains before reading, so all three
+                # transfers are visible (read-your-writes).
+                ids = np.zeros(3, dtype=types.ID_DTYPE)
+                ids["lo"] = [10, 11, 12]
+                r = do_request(cl, c, Operation.LOOKUP_TRANSFERS, ids.tobytes())
+                recs = np.frombuffer(bytearray(r.body), dtype=types.TRANSFER_DTYPE)
+                assert [int(x) for x in recs["id_lo"]] == [10, 11, 12]
+            cl.quiesce()
+            cl.check_state_convergence()
+        finally:
+            cl.close()
+
+    def test_checkpoint_drains_queued_store_jobs(self):
+        """A checkpoint-boundary op committing with store jobs and
+        compaction beats queued behind it: _maybe_checkpoint drains the
+        stage before encoding the trailer, so the checkpoint captures
+        every op ≤ boundary and the bytes converge across replicas. The
+        workers are frozen (condition held) while the boundary commits,
+        guaranteeing the queues are non-empty at drain time."""
+        import contextlib
+
+        cl = Cluster(replica_count=3, seed=21, store_async=True)
+        try:
+            c = setup_client(cl)
+            do_request(cl, c, Operation.CREATE_ACCOUNTS, account_batch([1, 2]))
+            interval = cl.config.checkpoint_interval
+            with contextlib.ExitStack() as stack:
+                for r in cl.replicas:
+                    stack.enter_context(r.store_executor._cond)
+                i = 0
+                while cl.replicas[0].superblock.state.op_checkpoint < interval:
+                    do_request(cl, c, Operation.CREATE_TRANSFERS, transfer_batch([
+                        dict(id=100 + i * 4 + k, debit_account_id=1,
+                             credit_account_id=2, amount=1, ledger=1, code=1)
+                        for k in range(4)
+                    ]))
+                    i += 1
+                    if cl.replicas[0].commit_min < interval - 1:
+                        # Workers frozen: jobs must be piling up.
+                        assert any(
+                            r.store_executor.unapplied_stores() or
+                            not r.store_executor.idle
+                            for r in cl.replicas
+                        )
+            target = max(r.commit_min for r in cl.replicas)
+            cl.run_until(lambda: all(
+                r.superblock.state.op_checkpoint >= interval
+                for r in cl.replicas if r is not None
+            ), 60_000)
+            cl.run_until(lambda: all(
+                r.commit_min >= target for r in cl.replicas if r is not None
+            ), 60_000)
+            cl.quiesce()
+            assert cl.check_storage_convergence() >= interval
+            assert cl.check_state_convergence() > 0
+        finally:
+            cl.close()
 
 
 class TestQueryOps:

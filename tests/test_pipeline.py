@@ -12,7 +12,7 @@ import pytest
 from tigerbeetle_tpu import types
 from tigerbeetle_tpu.vsr import header as hdr
 from tigerbeetle_tpu.vsr.header import Command, Header, Message, ReplyBuilder
-from tigerbeetle_tpu.vsr.pipeline import CommitExecutor
+from tigerbeetle_tpu.vsr.pipeline import CommitExecutor, StoreExecutor
 from tigerbeetle_tpu.vsr.replica import _parse_headers
 
 
@@ -144,6 +144,140 @@ class TestCommitExecutor:
         ex.submit({"op": 1})
         assert event.wait(5.0)
         with pytest.raises(RuntimeError, match="commit executor stage failed"):
+            posts[0]()
+
+
+class TestStoreExecutor:
+    """Unit tests for the async LSM store stage (vsr/pipeline.py
+    StoreExecutor): strict in-order drain, the pending-write-buffer
+    snapshot, park/resume on faults, and submit backpressure."""
+
+    def test_in_order_drain_and_buffer_visibility(self):
+        applied = []
+
+        def process(job):
+            # The in-flight job must still be visible as an unapplied
+            # store until its store phase lands.
+            assert job["store"] in se.unapplied_stores()
+            applied.append(job["op"])
+            job["stored"] = True
+            assert job["store"] not in se.unapplied_stores()
+            return None
+
+        se = StoreExecutor(process=process, post=lambda cb: cb())
+        for op in range(1, 9):
+            se.submit({"op": op, "store": (f"recs{op}", None)})
+        se.drain()
+        assert applied == list(range(1, 9))
+        assert se.unapplied_stores() == []
+        assert se.idle
+        se.stop()
+
+    def test_park_resume_preserves_order(self):
+        applied = []
+        notified = threading.Event()
+        fail_once = [True]
+
+        def process(job):
+            if job["op"] == 2 and fail_once[0]:
+                fail_once[0] = False
+                job["fault"] = IOError("corrupt block")
+                return job
+            applied.append(job["op"])
+            job["stored"] = True
+            return None
+
+        posts = []
+
+        def post(cb):
+            posts.append(cb)
+            notified.set()
+
+        se = StoreExecutor(process=process, post=post, notify=lambda: None)
+        for op in (1, 2, 3, 4):
+            se.submit({"op": op, "store": ((op,), None)})
+        assert notified.wait(5.0)
+        _wait(lambda: se.parked)
+        assert applied == [1]
+        assert isinstance(se.fault, IOError)
+        # Jobs 3, 4 are still queued (and still in the write buffer).
+        assert [s for s, _ in se.unapplied_stores()] == [(3,), (4,)]
+        faulted = se.pop_done()
+        assert faulted["op"] == 2
+        se.resume(faulted)  # repaired: back at the queue head
+        se.drain()
+        assert applied == [1, 2, 3, 4]
+        se.stop()
+
+    def test_submit_backpressure_bounds_queue(self):
+        release = threading.Event()
+
+        def process(job):
+            release.wait(10.0)
+            return None
+
+        se = StoreExecutor(process=process, post=lambda cb: cb(), depth_max=2)
+        se.submit({"op": 1})  # picked up by the worker (blocks in process)
+        _wait(lambda: not se.idle)
+        se.submit({"op": 2})
+        se.submit({"op": 3})  # queue now at depth_max
+
+        blocked = threading.Event()
+
+        def producer():
+            se.submit({"op": 4})  # must wait for a slot
+            blocked.set()
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        assert not blocked.wait(0.2), "submit must block at depth_max"
+        release.set()
+        assert blocked.wait(5.0)
+        se.drain()
+        se.stop()
+
+    def test_reset_discards_queue_and_waits_for_inflight(self):
+        started = threading.Event()
+        release = threading.Event()
+        applied = []
+
+        def process(job):
+            started.set()
+            release.wait(10.0)
+            applied.append(job["op"])
+            return None
+
+        se = StoreExecutor(process=process, post=lambda cb: cb())
+        se.submit({"op": 1, "store": ((1,), None)})
+        se.submit({"op": 2, "store": ((2,), None)})
+        assert started.wait(5.0)
+
+        def releaser():
+            time.sleep(0.05)
+            release.set()
+
+        threading.Thread(target=releaser, daemon=True).start()
+        out = se.reset()  # waits for op 1, discards op 2
+        assert applied == [1]
+        assert [j["op"] for j in out] == [2]
+        assert se.unapplied_stores() == []
+        se.stop()
+
+    def test_poison_on_unexpected_exception(self):
+        posts = []
+        event = threading.Event()
+
+        def post(cb):
+            posts.append(cb)
+            event.set()
+
+        def process(job):
+            raise ValueError("unexpected")
+
+        se = StoreExecutor(process=process, post=post)
+        se.submit({"op": 1})
+        assert event.wait(5.0)
+        with pytest.raises(RuntimeError, match="store executor stage failed"):
             posts[0]()
 
 

@@ -111,13 +111,28 @@ def cmd_start(args) -> int:
     # the co-located bench client), paying GIL handoffs for no
     # parallelism — auto-select the serial fallback there.
     # TIGERBEETLE_TPU_OVERLAP=1/0 forces either way.
-    force = _os.environ.get("TIGERBEETLE_TPU_OVERLAP")
-    if force is not None:
-        overlap = force not in ("", "0")
-    else:
-        overlap = (_os.cpu_count() or 1) >= 3
-    overlap = overlap and not args.serial_commit
-    if overlap:
+    def stage_enabled(env: str, min_cpus: int, disabled: bool) -> bool:
+        """Adaptive per-stage default: env var forces (1/0), else ON when
+        the host has at least min_cpus; the CLI flag disables outright."""
+        force = _os.environ.get(env)
+        if force is not None:
+            enabled = force not in ("", "0")
+        else:
+            enabled = (_os.cpu_count() or 1) >= min_cpus
+        return enabled and not disabled
+
+    overlap = stage_enabled("TIGERBEETLE_TPU_OVERLAP", 3, args.serial_commit)
+    # Async LSM store stage (docs/COMMIT_PIPELINE.md StoreExecutor):
+    # groove/index writes + compaction beats run off the commit path on a
+    # dedicated thread. Unlike the commit executor, the store thread's
+    # heavy work is C/numpy that releases the GIL (fused sort+gather,
+    # memcpy, bloom adds), so it overlaps usefully even on 2 CPUs —
+    # adaptive default is ON at >=2 CPUs, serial below (a 1-CPU box only
+    # pays thread handoffs).
+    store_async = stage_enabled(
+        "TIGERBEETLE_TPU_STORE_ASYNC", 2, args.serial_store
+    )
+    if overlap or store_async:
         # The executor thread's numpy stints and the event loop contend
         # for the GIL: the switch interval trades executor burst length
         # against request-intake latency. TIGERBEETLE_TPU_SWITCH_INTERVAL
@@ -125,7 +140,9 @@ def cmd_start(args) -> int:
         si = _os.environ.get("TIGERBEETLE_TPU_SWITCH_INTERVAL")
         if si:
             sys.setswitchinterval(float(si))
-    server = ReplicaServer(replica, addresses, overlap=overlap)
+    server = ReplicaServer(
+        replica, addresses, overlap=overlap, store_async=store_async
+    )
     replica.open()
     host, port = addresses[args.replica]
 
@@ -294,6 +311,8 @@ def cmd_benchmark(args) -> int:
         ]
         if args.serial_commit:
             server_args.append("--serial-commit")
+        if args.serial_store:
+            server_args.append("--serial-store")
         proc = subprocess.Popen(
             server_args + [path],
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
@@ -481,6 +500,9 @@ def main(argv=None) -> int:
     s.add_argument("--serial-commit", action="store_true",
                    help="disable the overlapped commit stage (execute "
                         "inline on the event loop)")
+    s.add_argument("--serial-store", action="store_true",
+                   help="disable the async LSM store stage (groove/index "
+                        "writes + compaction beats inline after each op)")
     s.set_defaults(fn=cmd_start)
 
     a = sub.add_parser("aof", help="AOF debug/merge/recover tooling")
@@ -517,6 +539,9 @@ def main(argv=None) -> int:
     b.add_argument("--backend", default="jax", choices=["jax", "numpy"])
     b.add_argument("--serial-commit", action="store_true",
                    help="run the server with the overlapped commit stage "
+                        "disabled (A/B comparison)")
+    b.add_argument("--serial-store", action="store_true",
+                   help="run the server with the async store stage "
                         "disabled (A/B comparison)")
     b.set_defaults(fn=cmd_benchmark)
 

@@ -250,7 +250,10 @@ class Grid:
         normal operation (reference grid_blocks_missing.zig:513)."""
         cached = self._cache.get(index)
         if cached is not None:
-            self._cache.move_to_end(index)
+            try:
+                self._cache.move_to_end(index)
+            except KeyError:
+                pass  # concurrently evicted: the payload is still valid
             self.cache_hits += 1
             return cached
         raw = self.storage.read(self._addr(index), self.block_size)
@@ -315,10 +318,21 @@ class Grid:
         self.free_set.commit_staged()
 
     def _cache_put(self, index: int, payload: bytes) -> None:
+        # Tolerant of concurrent use by the commit thread and the async
+        # store stage: each OrderedDict operation is GIL-atomic, and the
+        # composed sequences only ever fail with KeyError when the two
+        # threads interleave (entry evicted between ops) — the cache is
+        # acceleration, never the source of truth.
         self._cache[index] = payload
-        self._cache.move_to_end(index)
+        try:
+            self._cache.move_to_end(index)
+        except KeyError:
+            pass
         while len(self._cache) > self._cache_blocks:
-            self._cache.popitem(last=False)
+            try:
+                self._cache.popitem(last=False)
+            except KeyError:
+                break
 
     def drop_cache(self) -> None:
         self._cache.clear()

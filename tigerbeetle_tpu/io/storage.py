@@ -125,7 +125,13 @@ class Zone:
 
 
 class MemStorage:
-    """In-memory storage with fault injection and a crash model."""
+    """In-memory storage with fault injection and a crash model.
+
+    Thread-safe for the simulated pipeline stages: the async store
+    (StoreExecutor) and commit-executor threads write/flush while the
+    sim thread reads, syncs, or crashes — one lock keeps the _unsynced
+    overlay and _data image consistent (FileStorage relies on pread/
+    pwrite atomicity instead)."""
 
     def __init__(self, size: int, seed: int = 0) -> None:
         self.size = size
@@ -134,6 +140,7 @@ class MemStorage:
         # with probability per write (torn-write model).
         self._unsynced: dict[int, bytes] = {}
         self._faulty_sectors: set[int] = set()
+        self._lock = threading.Lock()
         import random
 
         self._rng = random.Random(seed)
@@ -142,6 +149,10 @@ class MemStorage:
 
     def read(self, offset: int, size: int) -> bytes:
         self.reads += 1
+        with self._lock:
+            return self._read_locked(offset, size)
+
+    def _read_locked(self, offset: int, size: int) -> bytes:
         out = bytearray(self._data[offset : offset + size])
         # Overlay unsynced writes (the OS page cache view).
         for woff, wdata in self._unsynced.items():
@@ -164,7 +175,8 @@ class MemStorage:
     def write(self, offset: int, data: bytes) -> None:
         assert offset + len(data) <= self.size
         self.writes += 1
-        self._unsynced[offset] = bytes(data)
+        with self._lock:
+            self._unsynced[offset] = bytes(data)
 
     def write_durable(self, offset: int, chunks: Sequence[bytes]) -> None:
         """Durable-at-return write (the O_DIRECT|O_DSYNC model): lands in
@@ -172,23 +184,29 @@ class MemStorage:
         data = b"".join(chunks)
         assert offset + len(data) <= self.size
         self.writes += 1
-        self._data[offset : offset + len(data)] = data
-        # An older buffered write at the same offset must not shadow the
-        # durable bytes through the read overlay.
-        self._unsynced.pop(offset, None)
+        with self._lock:
+            self._data[offset : offset + len(data)] = data
+            # An older buffered write at the same offset must not shadow
+            # the durable bytes through the read overlay.
+            self._unsynced.pop(offset, None)
 
     def writeback_kick(self, offset: int, nbytes: int) -> None:
         pass  # page-cache writeback pacing: meaningless in memory
 
     def sync(self) -> None:
-        for woff, wdata in self._unsynced.items():
-            self._data[woff : woff + len(wdata)] = wdata
-        self._unsynced = {}
+        with self._lock:
+            for woff, wdata in self._unsynced.items():
+                self._data[woff : woff + len(wdata)] = wdata
+            self._unsynced = {}
 
     # --- fault injection ------------------------------------------------
 
     def crash(self, torn_write_probability: float = 0.5) -> None:
         """Lose or tear unsynced writes, then clear them (process crash)."""
+        with self._lock:
+            self._crash_locked(torn_write_probability)
+
+    def _crash_locked(self, torn_write_probability: float) -> None:
         for woff, wdata in self._unsynced.items():
             r = self._rng.random()
             if r < torn_write_probability:

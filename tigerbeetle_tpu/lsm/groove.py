@@ -156,18 +156,26 @@ class HistoryGroove:
         return self.log.count
 
     def append_batch(self, recs: np.ndarray) -> None:
-        """Append history rows; index each present side's account id."""
+        """Append history rows; index each present side's account id —
+        ONE coalesced unsorted insert for both sides (the index is
+        non-unique and account_rows() sorts values at read time, so the
+        per-commit sort the old two insert_batch calls paid bought
+        nothing)."""
         if len(recs) == 0:
             return
         row_ids = self.log.append_batch(recs)
+        parts_k, parts_v = [], []
         for side in ("dr", "cr"):
             lo = recs[f"{side}_account_id_lo"]
             hi = recs[f"{side}_account_id_hi"]
             present = (lo != 0) | (hi != 0)
             if present.any():
-                self.rows.insert_batch(
-                    pack_keys(lo[present], hi[present]), row_ids[present]
-                )
+                parts_k.append(pack_keys(lo[present], hi[present]))
+                parts_v.append(row_ids[present])
+        if parts_k:
+            self.rows.insert_unsorted(
+                np.concatenate(parts_k), np.concatenate(parts_v)
+            )
 
     def account_rows(self, account_id: int) -> np.ndarray:
         """All history rows touching the account, ascending timestamp

@@ -191,10 +191,11 @@ class U128Index:
         # newest-wins order, matching NativeU128Map's overwrite semantics
         # (keys are unique by contract, but a silent inversion here would
         # make any future re-insert return stale values — ADVICE r3).
+        # Fused C sort+gather (sort_kv) — one call instead of argsort +
+        # two fancy-index passes, same stable order.
         keys = np.concatenate([k for k, _ in reversed(self._mem)])
         vals = np.concatenate([v for _, v in reversed(self._mem)])
-        order = sort_lo_major(keys)
-        self._runs.append((keys[order], vals[order]))
+        self._runs.append(sort_kv(keys, vals))
         self._mem = []
         self._mem_count = 0
 
@@ -202,8 +203,7 @@ class U128Index:
         # Same newest-first discipline across runs (later runs are newer).
         keys = np.concatenate([k for k, _ in reversed(self._runs)])
         vals = np.concatenate([v for _, v in reversed(self._runs)])
-        order = sort_lo_major(keys)
-        self._runs = [(keys[order], vals[order])]
+        self._runs = [sort_kv(keys, vals)]
 
     def lookup_batch(self, keys: np.ndarray) -> np.ndarray:
         """(n,) KEY_DTYPE → (n,) u32 values, NOT_FOUND where absent."""

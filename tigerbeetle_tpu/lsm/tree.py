@@ -25,6 +25,7 @@ always rewind to the last durable manifest.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
@@ -34,6 +35,7 @@ from tigerbeetle_tpu.io.grid import Grid, GridReadFault
 from tigerbeetle_tpu.lsm.store import (
     KEY_DTYPE,
     NOT_FOUND,
+    Bloom,
     search_run,
     sort_kv,
     sort_lo_major,
@@ -90,6 +92,17 @@ class TableInfo:
     # Decoded index entries, lazily cached (the index block itself also sits
     # in the grid's LRU, this just skips re-parsing).
     _fences: Optional[np.ndarray] = None
+    # Per-run Bloom filter over the table's keys (~1 byte/entry, no false
+    # negatives): point lookups skip this table entirely unless the bloom
+    # flags a key — dup-checks and query reads stop probing cold runs.
+    # Built LAZILY on the table's first probe (with the decoded mirror,
+    # or one streaming pass for over-budget tables) so pure-ingest
+    # workloads never pay the build; None means "probe normally".
+    bloom: Optional[Bloom] = None
+    # Set by _release_table (compaction retire): a reader racing the
+    # retire may still probe the table, but must not install its mirror
+    # into the LRU budget — the table is unreachable from the levels.
+    _released: bool = False
     # Whole-table decoded mirror (keys, vals), LRU-budgeted at the tree
     # (see DurableIndex._decode_table): tables are immutable, so a point
     # lookup becomes ONE vectorized search over the concatenated run
@@ -98,6 +111,17 @@ class TableInfo:
     # reference's set-associative value cache serves the same role,
     # set_associative_cache.zig:15).
     _decoded: Optional[Tuple[np.ndarray, np.ndarray]] = None
+
+
+def _key_bloom(keys: np.ndarray) -> Bloom:
+    """Per-run Bloom over a table's keys (RAM-only read acceleration —
+    results are identical with or without it: no false negatives).
+    Sized at ~16 bits/key (2 bytes RAM per table row): per-key FP ~1.6%,
+    so an 8190-key miss batch probes a flagged table with ~130 keys
+    instead of the whole batch."""
+    b = Bloom(2 * len(keys))
+    b.add(keys["lo"], keys["hi"])
+    return b
 
 
 class _TableReader:
@@ -186,9 +210,13 @@ class DurableIndex:
         # (level, captured input tables, reservation) of a fault-aborted
         # job, recreated verbatim on retry.
         self._aborted_resv: Optional[tuple] = None
-        # Whole-table decoded-mirror LRU (see _decode_table).
+        # Whole-table decoded-mirror LRU (see _decode_table). The lock
+        # covers ONLY the LRU bookkeeping (list + row counter): the
+        # commit thread's drain-free dup-confirm touches mirrors while
+        # the store thread's compaction retire releases tables.
         self._decoded_lru: List[TableInfo] = []
         self._decoded_rows = 0
+        self._lru_lock = threading.Lock()
 
     # --- geometry -------------------------------------------------------
 
@@ -205,19 +233,26 @@ class DurableIndex:
     def insert_batch(self, keys: np.ndarray, values: np.ndarray) -> None:
         if len(keys) == 0:
             return
-        keys = np.asarray(keys)
+        keys = np.ascontiguousarray(keys)
         vals = np.asarray(values, dtype=np.uint32)
-        # Sort each batch once at insert time so lookups never re-sort.
-        order = sort_lo_major(keys)
-        self.insert_sorted(keys[order], vals[order])
+        # Sort each batch once at insert time so lookups never re-sort —
+        # through the fused C sort+gather (one call instead of the
+        # argsort + two fancy-index passes).
+        self.insert_sorted(*sort_kv(keys, vals))
 
     def insert_sorted(self, keys: np.ndarray, vals: np.ndarray) -> None:
         """Append a batch already in lo-major stable order (the C staging
-        path pre-sorts during extraction, hostops_build_sorted_kv)."""
+        path pre-sorts during extraction, hostops_build_sorted_kv).
+
+        Flag-before-batch publish order: a concurrent drain-free reader
+        (the transfer-id index dup-confirm on the commit thread) that
+        observes the new batch also observes its sorted flag, so it
+        never takes _sort_mem_lazily's mutation branch against a tree
+        the store thread is appending to."""
         if len(keys) == 0:
             return
-        self._mem.append((keys, vals))
         self._mem_sorted.append(True)
+        self._mem.append((keys, vals))
         self._mem_count += len(keys)
         self.count += len(keys)
         if self._mem_count >= self.memtable_max:
@@ -228,11 +263,13 @@ class DurableIndex:
         indexes whose reads either tolerate unsorted memtable batches
         (lookup_range scans them with a mask) or trigger the lazy sort in
         lookup_batch. The flush re-sorts the whole memtable anyway, so
-        deferring drops one radix pass per commit off the hot path."""
+        deferring drops one radix pass per commit off the hot path.
+        (Never used for the drain-free-read transfer-id index, whose
+        batches are all insert-time sorted.)"""
         if len(keys) == 0:
             return
-        self._mem.append((keys, vals))
         self._mem_sorted.append(False)
+        self._mem.append((keys, vals))
         self._mem_count += len(keys)
         self.count += len(keys)
         if self._mem_count >= self.memtable_max:
@@ -240,29 +277,47 @@ class DurableIndex:
 
     def _sort_mem_lazily(self) -> None:
         """Point-lookup prerequisite: every memtable batch lo-major sorted
-        (unsorted ones arrive via insert_unsorted)."""
-        if len(self._mem_sorted) < len(self._mem) or not all(self._mem_sorted):
-            for i, (k, v) in enumerate(self._mem):
-                if i >= len(self._mem_sorted) or not self._mem_sorted[i]:
-                    order = sort_lo_major(k)
-                    self._mem[i] = (k[order], v[order])
-            self._mem_sorted = [True] * len(self._mem)
+        (unsorted ones arrive via insert_unsorted). Operates on local
+        snapshots, FLAGS FIRST: the writer publishes flag-before-batch
+        (inserts) and clears mem-before-flags (flush), so a flags-then-mem
+        read can never observe a batch without its flag — a tree whose
+        batches are all insert-time sorted therefore never enters the
+        mutation loop, and the drain-free concurrent reader cannot race
+        the store thread's appends (unsorted-batch trees are only ever
+        read behind a full store barrier)."""
+        flags = self._mem_sorted
+        mem = self._mem
+        if len(flags) >= len(mem) and all(flags):
+            return
+        for i in range(len(mem)):
+            if i >= len(flags) or not flags[i]:
+                k, v = mem[i]
+                order = sort_lo_major(k)
+                mem[i] = (k[order], v[order])
+        self._mem_sorted = [True] * len(mem)
 
     def flush_memtable(self) -> None:
         """Write the memtable as one sorted level-0 table. Compaction is
         NOT triggered here — it runs incrementally via compact_step (the
         bar/beat pacing, compaction.zig:1-31), so a flush costs one table
-        build, never a level fold."""
+        build, never a level fold.
+
+        Publish-then-clear ordering: the table is appended to level 0
+        BEFORE the memtable is cleared, so a concurrent drain-free reader
+        (the async store stage's duplicate-confirm consults this tree
+        from the commit thread) never observes a window where the flushed
+        entries are in neither place. Transient double visibility is
+        harmless for point lookups (same key → same value)."""
         if self._mem_count == 0:
             return
         keys = np.concatenate([k for k, _ in self._mem])
         vals = np.concatenate([v for _, v in self._mem])
         keys, vals = sort_kv(keys, vals)  # fused C sort+gather
+        table = self._build_table(keys, vals)
+        self.levels[0].append(table)
         self._mem = []
         self._mem_sorted = []
         self._mem_count = 0
-        table = self._build_table(keys, vals)
-        self.levels[0].append(table)
 
     def _build_table(self, keys: np.ndarray, vals: np.ndarray) -> TableInfo:
         """Write sorted entries as data blocks + one index block."""
@@ -322,13 +377,15 @@ class DurableIndex:
         return keys, vals
 
     def _release_table(self, table: TableInfo) -> None:
-        if table._decoded is not None:
-            table._decoded = None
-            self._decoded_rows -= table.count
-            try:
-                self._decoded_lru.remove(table)
-            except ValueError:
-                pass
+        with self._lru_lock:
+            table._released = True
+            if table._decoded is not None:
+                table._decoded = None
+                self._decoded_rows -= table.count
+                try:
+                    self._decoded_lru.remove(table)
+                except ValueError:
+                    pass
         for f in self._table_fences(table):
             self.grid.release(int(f["block"]))
         self.grid.release(table.index_block)
@@ -405,13 +462,18 @@ class DurableIndex:
         out = job.writer.finish()
         for b in job.writer.unused_reservation():
             self.grid.free_set.release(b)  # forfeit (usually empty)
+        # Publish-then-retire: the merged output becomes visible BEFORE
+        # the input tables leave their level, so a concurrent drain-free
+        # reader walking newest-first always finds every entry in at
+        # least one of the two (merges preserve content; transient double
+        # visibility resolves to the same values).
+        if job.level + 1 >= len(self.levels):
+            self.levels.append([])
+        self.levels[job.level + 1].extend(out)
         captured = set(id(t) for t in job.tables)
         self.levels[job.level] = [
             t for t in self.levels[job.level] if id(t) not in captured
         ]
-        if job.level + 1 >= len(self.levels):
-            self.levels.append([])
-        self.levels[job.level + 1].extend(out)
         for t in job.tables:
             self._release_table(t)
 
@@ -514,30 +576,63 @@ class DurableIndex:
 
     def _decode_table(self, table: TableInfo) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         """Concatenated (keys, vals) mirror of an immutable table, LRU
-        budgeted tree-wide."""
-        if table._decoded is not None:
-            # LRU touch.
-            try:
-                self._decoded_lru.remove(table)
-            except ValueError:
-                pass
-            self._decoded_lru.append(table)
-            return table._decoded
+        budgeted tree-wide. Block reads and the mirror build run outside
+        the LRU lock; only the bookkeeping is serialized against the
+        store thread's _release_table."""
+        with self._lru_lock:
+            decoded = table._decoded
+            if decoded is not None:
+                # LRU touch.
+                try:
+                    self._decoded_lru.remove(table)
+                except ValueError:
+                    pass
+                self._decoded_lru.append(table)
+                return decoded
         if table.count < self.DECODE_MIN_ROWS or table.count > self.DECODE_BUDGET_ROWS:
             return None
-        while self._decoded_rows + table.count > self.DECODE_BUDGET_ROWS and self._decoded_lru:
-            victim = self._decoded_lru.pop(0)
-            self._decoded_rows -= victim.count
-            victim._decoded = None
         parts_k, parts_v = [], []
         for f in self._table_fences(table):
             bk, bv = self._read_data_block(int(f["block"]), int(f["count"]))
             parts_k.append(bk)
             parts_v.append(bv)
-        table._decoded = (np.concatenate(parts_k), np.concatenate(parts_v))
-        self._decoded_rows += table.count
-        self._decoded_lru.append(table)
-        return table._decoded
+        decoded = (np.concatenate(parts_k), np.concatenate(parts_v))
+        # The mirror build is the first time the table's keys are in RAM
+        # — bloom them now so later miss-heavy lookups can skip the run
+        # without touching it at all.
+        bloom = _key_bloom(decoded[0]) if table.bloom is None else None
+        with self._lru_lock:
+            if table._released:
+                # Retired while we were building (compaction racing a
+                # drain-free reader): serve this probe from the local
+                # mirror but never install it — a dead table must not
+                # occupy decode budget and evict live mirrors.
+                return decoded
+            if table._decoded is None:
+                while (
+                    self._decoded_rows + table.count > self.DECODE_BUDGET_ROWS
+                    and self._decoded_lru
+                ):
+                    victim = self._decoded_lru.pop(0)
+                    self._decoded_rows -= victim.count
+                    victim._decoded = None
+                table._decoded = decoded
+                if bloom is not None and table.bloom is None:
+                    table.bloom = bloom
+                self._decoded_rows += table.count
+                self._decoded_lru.append(table)
+            return table._decoded
+
+    def _stream_bloom(self, table: TableInfo) -> Bloom:
+        """Bloom a table that exceeds the decode budget: one streaming
+        pass over its data blocks (paid once, on first probe — from then
+        on misses skip the table without IO)."""
+        b = Bloom(2 * table.count)
+        for f in self._table_fences(table):
+            bk, _bv = self._read_data_block(int(f["block"]), int(f["count"]))
+            b.add(bk["lo"], bk["hi"])
+        table.bloom = b
+        return b
 
     def lookup_batch(self, keys: np.ndarray) -> np.ndarray:
         n = len(keys)
@@ -556,7 +651,41 @@ class DurableIndex:
         for table in self._tables_newest_first():
             if not pending.any():
                 break
-            decoded = self._decode_table(table)
+            # Per-run bloom gate: probe the table only for keys it might
+            # hold — a miss-heavy batch (dup-check of fresh ids) skips
+            # cold runs without a single block read. Blooms materialize
+            # on a run's FIRST probe (never during ingest): piggybacked
+            # on the decoded mirror, or one streaming pass when the
+            # table exceeds the mirror budget.
+            bloom = table.bloom
+            decoded = None
+            if bloom is None and table.count >= self.DECODE_MIN_ROWS:
+                decoded = self._decode_table(table)
+                bloom = table.bloom  # built with the mirror (when installed)
+                if decoded is None and bloom is None:
+                    bloom = self._stream_bloom(table)
+            if bloom is not None:
+                flagged = pending & bloom.maybe(keys["lo"], keys["hi"])
+                if not flagged.any():
+                    continue
+                # Compact to the flagged keys: the probe's searchsorted
+                # passes then scale with the bloom hits (~1.6% FP), not
+                # the whole batch.
+                ix = np.nonzero(flagged)[0]
+                sub_out = out[ix]
+                sub_pending = np.ones(len(ix), dtype=bool)
+                if decoded is None:
+                    decoded = self._decode_table(table)
+                if decoded is not None:
+                    search_run(decoded[0], decoded[1], keys[ix], sub_out, sub_pending)
+                else:
+                    self._lookup_table(table, keys[ix], sub_out, sub_pending)
+                resolved = ix[~sub_pending]
+                out[resolved] = sub_out[~sub_pending]
+                pending[resolved] = False
+                continue
+            if decoded is None:
+                decoded = self._decode_table(table)
             if decoded is not None:
                 search_run(decoded[0], decoded[1], keys, out, pending)
             else:
@@ -889,11 +1018,9 @@ class _CompactionJob:
             for k, v in zip(parts_k[1:], parts_v[1:]):
                 mk, mv = self.tree._merge_chunk(mk, mv, k, v)
             return mk, mv
-        # Host path: concatenate oldest-first + stable radix argsort.
-        k = np.concatenate(parts_k)
-        v = np.concatenate(parts_v)
-        order = sort_lo_major(k)
-        return k[order], v[order]
+        # Host path: concatenate oldest-first + fused stable radix
+        # sort+gather (one C call; byte-identical to argsort + gather).
+        return sort_kv(np.concatenate(parts_k), np.concatenate(parts_v))
 
 
 class _TableWriter:

@@ -253,11 +253,17 @@ class StateMachine:
 
         # Deferred object-store work for the LAST committed batch:
         # (records, ts override). The reply depends only on validate+post,
-        # so the commit path sends it before storing; flush_deferred runs
+        # so the commit path sends it before storing; store_barrier runs
         # before anything that reads the store (every public operation
-        # guards, and the replica's _finish_commit flushes in strict op
-        # order for determinism).
+        # guards, and the replica's _finish_commit applies it in strict
+        # op order for determinism — inline, or as a StoreExecutor job
+        # when the async store stage is attached).
         self._deferred_store = None
+        # Optional async store stage (vsr/pipeline.StoreExecutor, attached
+        # by the replica): queued jobs hold this state machine's pending
+        # groove/index writes + beats; store_barrier drains it before any
+        # store read (read-your-writes).
+        self._store_stage = None
         # Resume point within compact_beat's stage list after a
         # GridReadFault was repaired (see compact_beat).
         self._beat_stage = 0
@@ -276,15 +282,82 @@ class StateMachine:
             "serial_batches": 0, "bail_batches": 0,
         }
 
+    def attach_store_stage(self, stage) -> None:
+        """Wire the async store stage (replica.attach_store_executor /
+        state-sync reinstall). Reads then synchronize via store_barrier."""
+        self._store_stage = stage
+
+    def store_barrier(self) -> None:
+        """Read-your-writes guard: every queued async store job and the
+        current op's deferred store are applied before a store read. A
+        stage parked on a corrupt block re-raises its GridReadFault here
+        — the caller's op aborts cleanly (requeued behind the repair)
+        instead of reading half-stored state."""
+        stage = self._store_stage
+        if stage is not None:
+            with tracer.span("sm.store.barrier"):
+                while True:
+                    stage.drain()
+                    # drain() returns either idle or parked; re-check in
+                    # a loop — the event-loop thread may repair and
+                    # resume() (requeueing the faulted job) between the
+                    # return and this read, in which case the queue is
+                    # live again and must be drained anew.
+                    fault = stage.fault
+                    if fault is not None and stage.parked:
+                        raise fault
+                    if stage.idle:
+                        break
+        self.flush_deferred()
+
     def flush_deferred(self) -> None:
         d = self._deferred_store
         if d is not None:
             self._deferred_store = None
             recs, ts = d
             with tracer.span("sm.ct.store"):
-                self._store_new_transfers(recs, ts=ts)
+                # Bloom membership was already published at defer time.
+                self._store_new_transfers(recs, ts=ts, add_bloom=False)
 
-    def _store_new_transfers(self, recs: np.ndarray, ts=None) -> None:
+    def _defer_store(self, recs: np.ndarray, ts=None) -> None:
+        """Schedule the batch's store work for _finish_commit (inline or
+        the async stage). Bloom membership is published NOW, on the
+        commit thread, so the next batch's duplicate-id pre-filter is
+        accurate without a store barrier — the only store state the hot
+        path consults ahead of the queued writes."""
+        self.transfer_seen.add(recs["id_lo"], recs["id_hi"])
+        self._deferred_store = (recs, ts)
+
+    def take_deferred_store(self):
+        """Pop the deferred batch for an async store job (replica
+        _finish_commit). None when the op stored inline (exact/serial
+        paths) or wrote nothing."""
+        d = self._deferred_store
+        self._deferred_store = None
+        return d
+
+    def _confirm_maybe_ids(self, flagged_keys: np.ndarray) -> bool:
+        """Duplicate confirm for bloom maybe-hits WITHOUT draining the
+        async store stage: the PENDING WRITE BUFFER (queued + in-flight
+        store jobs) is consulted first, then the durable id index — which
+        at that instant is missing at most the batches still in the
+        buffer, so every committed id is visible in at least one of the
+        two. Safe to read concurrently with the store thread because the
+        id index's memtable batches are always insert-time sorted (no
+        lazy re-sort mutation) and flush/compaction publish-then-retire
+        (lsm/tree.py). Conservative on id_lo alone for the buffer probe:
+        a false positive only routes the batch to the byte-exact serial
+        path, never mis-answers."""
+        stage = self._store_stage
+        if stage is not None:
+            for recs, _ts in stage.unapplied_stores():
+                if bool(np.isin(flagged_keys["lo"], recs["id_lo"]).any()):
+                    return True
+        return self.transfer_index.contains_any(flagged_keys)
+
+    def _store_new_transfers(
+        self, recs: np.ndarray, ts=None, add_bloom: bool = True
+    ) -> None:
         """Append committed transfers to the object log and both indexes
         (reference groove insert: object tree + id tree + secondary
         indexes, groove.zig:138). `ts` optionally overrides the stored
@@ -292,18 +365,24 @@ class StateMachine:
         caller's event array is not mutated)."""
         with tracer.span("sm.store.log"):
             rows = self.transfer_log.append_batch(recs, ts=ts)
-            self.transfer_seen.add(recs["id_lo"], recs["id_hi"])
+            if add_bloom:
+                self.transfer_seen.add(recs["id_lo"], recs["id_hi"])
         if not self._store_native(recs, int(rows[0]) if len(rows) else 0):
             with tracer.span("sm.store.idx"):
                 self.transfer_index.insert_batch(
                     pack_keys(recs["id_lo"], recs["id_hi"]), rows
                 )
             with tracer.span("sm.store.rows"):
+                # One coalesced unsorted append (like the native path):
+                # account_rows is non-unique and write-heavy — the flush
+                # re-sorts the whole memtable, so a per-commit radix pass
+                # here is pure waste, and the stable flush sort makes the
+                # table bytes identical either way.
                 acct_keys = np.concatenate([
                     pack_keys(recs["debit_account_id_lo"], recs["debit_account_id_hi"]),
                     pack_keys(recs["credit_account_id_lo"], recs["credit_account_id_hi"]),
                 ])
-                self.account_rows.insert_batch(
+                self.account_rows.insert_unsorted(
                     acct_keys, np.concatenate([rows, rows])
                 )
         self._store_query_index(recs, rows, ts)
@@ -418,14 +497,20 @@ class StateMachine:
     # background storage work interleaved between commits, so the commit →
     # reply path itself performs no grid IO.
 
-    def compact_beat(self, max_blocks: int = 8) -> None:
+    def compact_beat(self, max_blocks: int = 8, flush: bool = True) -> None:
         """One beat of deferred storage work: flush up to `max_blocks` of
         the object log's pending blocks and run one bounded compaction
         step on each durable index. Driven once per committed op from
         inside the commit apply path — WAL replay re-runs the identical
         beat sequence, so grid allocation order (and therefore checkpoint
-        bytes) stays deterministic across replicas and restarts."""
-        self.flush_deferred()  # the op's store precedes its beat, always
+        bytes) stays deterministic across replicas and restarts.
+
+        flush=False (async store jobs, which apply their op's store
+        explicitly before the beat): _deferred_store belongs to the
+        COMMIT thread — reading it from the store thread would race the
+        next op's defer (stealing or double-applying its batch)."""
+        if flush:
+            self.flush_deferred()  # the op's store precedes its beat, always
         # Stage-resumable: a GridReadFault mid-beat (corrupt compaction
         # input) aborts that stage atomically (tree-level abort_block) and
         # the RETRY after repair resumes at the faulted stage — re-running
@@ -441,10 +526,11 @@ class StateMachine:
             lambda: self.posted.compact_step(quota),
             lambda: self.history.compact_step(quota),
         )
-        while self._beat_stage < len(stages):
-            stages[self._beat_stage]()
-            self._beat_stage += 1
-        self._beat_stage = 0
+        with tracer.span("sm.beat"):
+            while self._beat_stage < len(stages):
+                stages[self._beat_stage]()
+                self._beat_stage += 1
+            self._beat_stage = 0
 
     # ------------------------------------------------------------------
     # balances access (device or host backend)
@@ -692,10 +778,12 @@ class StateMachine:
                 hard = _batch_has_dup(events)
             if not hard and self.transfer_seen.count:
                 # Bloom pre-filter: only keys the filter flags (stored ids
-                # plus ~2% false positives) hit the real index.
+                # plus ~2% false positives) hit the real index. The bloom
+                # is published at defer time (commit-thread-side), so the
+                # stage barrier is only paid on a maybe-hit.
                 maybe = self.transfer_seen.maybe(events["id_lo"], events["id_hi"])
                 if maybe.any():
-                    hard = self.transfer_index.contains_any(keys[maybe])
+                    hard = self._confirm_maybe_ids(keys[maybe])
         pv_keys = None
         if not hard and bool(np.any(is_pv)):
             # lo-major sort with hi tiebreak so the in-batch pending_id
@@ -801,9 +889,14 @@ class StateMachine:
 
         ok = codes == 0
         if np.any(ok):
-            recs = events[ok].copy()
-            recs["timestamp"] = ts[ok]
-            self._store_new_transfers(recs)
+            if ok.all():
+                # Zero-copy defer: the log's append stamps timestamps
+                # during its own copy (same contract as the numpy path).
+                self._defer_store(events, ts)
+            else:
+                recs = events[ok].copy()
+                recs["timestamp"] = ts[ok]
+                self._defer_store(recs)
             self.commit_timestamp = int(ts[ok][-1])
         return _codes_to_results(codes)
 
@@ -854,11 +947,12 @@ class StateMachine:
             ):
                 return None
         if bits & 4:
-            # Bloom maybe-hits: confirm against the durable index (reads
-            # the LSM — a GridReadFault here aborts the dispatch cleanly;
-            # nothing was mutated).
+            # Bloom maybe-hits: confirm against the pending write buffer
+            # + durable index (drain-free — reads the LSM, so a
+            # GridReadFault here aborts the dispatch cleanly; nothing
+            # was mutated).
             m = maybe_u8.astype(bool)
-            if self.transfer_index.contains_any(
+            if self._confirm_maybe_ids(
                 pack_keys(events["id_lo"][m], events["id_hi"][m])
             ):
                 return None
@@ -908,9 +1002,12 @@ class StateMachine:
         codes = np.asarray(handle["codes"])[:n]
         ok = codes == 0
         if np.any(ok):
-            recs = events[ok].copy()
-            recs["timestamp"] = ts[ok]
-            self._store_new_transfers(recs)
+            if ok.all():
+                self._defer_store(events, ts)
+            else:
+                recs = events[ok].copy()
+                recs["timestamp"] = ts[ok]
+                self._defer_store(recs)
             self.commit_timestamp = int(ts[ok][-1])
         return _codes_to_results(codes)
 
@@ -942,10 +1039,11 @@ class StateMachine:
         hard = bool(bits & 1)  # duplicate ids within the batch
         if not hard and (bits & 4):
             # Bloom hits: stored ids (or ~2% false positives) — confirm
-            # against the durable index for just the flagged keys.
+            # against the pending write buffer + durable index for just
+            # the flagged keys (drain-free: see _confirm_maybe_ids).
             with tracer.span("sm.ct.dupcheck"):
                 m = maybe_u8.astype(bool)
-                hard = self.transfer_index.contains_any(
+                hard = self._confirm_maybe_ids(
                     pack_keys(events["id_lo"][m], events["id_hi"][m])
                 )
         pv_keys = None
@@ -1020,11 +1118,11 @@ class StateMachine:
                 # Zero-copy: the log's append stamps timestamps during
                 # its own copy; `events` is never mutated (the view keeps
                 # the wire body alive via the array base).
-                self._deferred_store = (events, ts)
+                self._defer_store(events, ts)
             else:
                 recs = events[ok].copy()
                 recs["timestamp"] = ts[ok]
-                self._deferred_store = (recs, None)
+                self._defer_store(recs)
             self.commit_timestamp = int(ts[ok][-1])
         return _codes_to_results(codes)
 
@@ -1166,6 +1264,10 @@ class StateMachine:
         linked chains, and pending post/void."""
         from tigerbeetle_tpu.ops import commit_exact
 
+        # Prefetch reads the id index/object log/posted groove, and the
+        # tail writes grooves inline: queued async store jobs must land
+        # first (the stage is then idle for the inline writes too).
+        self.store_barrier()
         n = len(events)
         pv_code, pinfo_np, pending_recs, p_rec_idx = self._exact_prefetch(
             events, is_pv, pv_keys
@@ -1487,6 +1589,9 @@ class StateMachine:
         )
 
     def _create_transfers_serial(self, events: np.ndarray, timestamp: int) -> np.ndarray:
+        # The oracle reads (and its writeback writes) the whole store
+        # tier: the async stage must be idle.
+        self.store_barrier()
         orc = self._make_oracle()
         # Prefetch round 1: dr/cr accounts, existing transfers by event id
         # and by pending_id (reference prefetch, state_machine.zig:560-655).
@@ -1536,6 +1641,7 @@ class StateMachine:
         return _results_array(pairs)
 
     def _create_accounts_serial(self, events: np.ndarray, timestamp: int) -> np.ndarray:
+        self.store_barrier()
         orc = self._make_oracle()
         self._preload_accounts(orc, pack_keys(events["id_lo"], events["id_hi"]))
         ev_objs = [oracle_mod.account_from_numpy(events[i]) for i in range(len(events))]
@@ -1630,7 +1736,7 @@ class StateMachine:
         exactly (fold56 collisions over-select, never mis-answer)."""
         from tigerbeetle_tpu.lsm import scan
 
-        self.flush_deferred()
+        self.store_barrier()
         ud128_lo = int(f["user_data_128_lo"])
         ud128_hi = int(f["user_data_128_hi"])
         ud64 = int(f["user_data_64"])
@@ -1810,7 +1916,7 @@ class StateMachine:
         return self._accounts_at(s[:limit].astype(np.int64))
 
     def lookup_transfers(self, ids_lo: np.ndarray, ids_hi: np.ndarray) -> np.ndarray:
-        self.flush_deferred()
+        self.store_barrier()
         keys = pack_keys(
             np.asarray(ids_lo, dtype=np.uint64), np.asarray(ids_hi, dtype=np.uint64)
         )
@@ -1823,7 +1929,7 @@ class StateMachine:
         an account-index range read + gather, O(account's transfers), not
         O(history) (reference ScanTree over the secondary index,
         scan_tree.zig:31)."""
-        self.flush_deferred()
+        self.store_barrier()
         key = pack_keys(
             np.array([account_id & U64_MAX], dtype=np.uint64),
             np.array([account_id >> 64], dtype=np.uint64),
@@ -1880,6 +1986,7 @@ class StateMachine:
         slot = self._slot_of_id(account_id)
         if slot < 0 or not (int(self.acc_flags[slot]) & int(AccountFlags.HISTORY)):
             return []
+        self.store_barrier()  # history groove rows may still be queued
         recs = self.history.account_rows(account_id)
         if len(recs) == 0:
             return []

@@ -129,7 +129,8 @@ class ReplicaServer:
     TICK_SECONDS = 0.01
 
     def __init__(
-        self, replica, addresses: List[Tuple[str, int]], overlap: bool = True
+        self, replica, addresses: List[Tuple[str, int]], overlap: bool = True,
+        store_async: bool = True,
     ) -> None:
         self.replica = replica
         self.addresses = addresses
@@ -147,6 +148,10 @@ class ReplicaServer:
         # keeps the async WAL but commits serially on the event loop (the
         # determinism-guard comparison runs both ways).
         self.overlap = overlap
+        # Async LSM store stage (StoreExecutor): groove/index writes +
+        # compaction beats trail the reply on a dedicated thread.
+        # store_async=False keeps store+beat inline in _finish_commit.
+        self.store_async = store_async
         replica.bus = self  # inject ourselves as the bus
 
     @property
@@ -230,6 +235,8 @@ class ReplicaServer:
             self.replica.journal.writer = self.replica.wal_writer
         if self.overlap and self.replica.executor is None:
             self.replica.attach_executor(post)
+        if self.store_async and self.replica.store_executor is None:
+            self.replica.attach_store_executor(post)
 
     async def serve_forever(self) -> None:
         if self._server is None:
@@ -242,6 +249,8 @@ class ReplicaServer:
             self._server.close()
         if self.replica.executor is not None:
             self.replica.executor.stop()
+        if self.replica.store_executor is not None:
+            self.replica.store_executor.stop()
         if self.replica.wal_writer is not None:
             self.replica.wal_writer.stop()
 

@@ -292,6 +292,17 @@ def _pending_expired(b: TransferBatch, p: PendingInfo):
     return (p.timeout != 0) & ~over & u128.ge(b.timestamp, deadline)
 
 
+def _axis_size(axis_name) -> int:
+    """Concrete named-axis size, portable across jax versions (the
+    top-level jax.lax.axis_size is newer than some supported jaxes,
+    whose core.axis_frame answers the same question)."""
+    try:
+        return jax.lax.axis_size(axis_name)
+    except AttributeError:
+        size = jax.core.axis_frame(axis_name)
+        return size if isinstance(size, int) else size.size
+
+
 def _exclusive_cumsum_mxu(vals: jnp.ndarray, axis_name: str | None = None) -> jnp.ndarray:
     """(m, k) u32 → exact exclusive prefix sums along axis 0, MXU-tiled.
 
@@ -311,7 +322,7 @@ def _exclusive_cumsum_mxu(vals: jnp.ndarray, axis_name: str | None = None) -> jn
     """
     m, k = vals.shape
     if axis_name is not None:
-        nd = jax.lax.axis_size(axis_name)
+        nd = _axis_size(axis_name)
         if nd > 1 and m % (128 * nd) == 0:
             rank = jax.lax.axis_index(axis_name)
             rows = m // nd

@@ -183,6 +183,7 @@ class Cluster:
         sm_backend: str = "numpy",
         standby_count: int = 0,
         overlap: bool = False,
+        store_async: bool = False,
     ) -> None:
         self.cluster_id = 0xC1
         # overlap=True attaches a real CommitExecutor thread to every
@@ -191,8 +192,10 @@ class Cluster:
         # asyncio loop. Execution timing then depends on thread
         # scheduling, but the COMMITTED chain must stay byte-identical to
         # a serial run — the determinism guard in tests/test_cluster.py
-        # compares both ways.
+        # compares both ways. store_async=True likewise attaches a real
+        # StoreExecutor thread (async LSM store stage) to every replica.
         self.overlap = overlap
+        self.store_async = store_async
         from collections import deque
 
         self._exec_posts = deque()
@@ -244,6 +247,10 @@ class Cluster:
             r.attach_executor(
                 lambda cb, _r=r: self._exec_posts.append((_r, cb))
             )
+        if self.store_async:
+            r.attach_store_executor(
+                lambda cb, _r=r: self._exec_posts.append((_r, cb))
+            )
         self.replicas[i] = r
 
     def _on_replica_event(self, kind: str, r: Replica) -> None:
@@ -260,6 +267,8 @@ class Cluster:
                 self.replicas[ix] = None
             if r.executor is not None:
                 r.executor.stop()
+            if r.store_executor is not None:
+                r.store_executor.stop()
             return
         if kind != "promoted":
             return
@@ -300,6 +309,8 @@ class Cluster:
         dead = self.replicas[i]
         if dead is not None and dead.executor is not None:
             dead.executor.stop()
+        if dead is not None and dead.store_executor is not None:
+            dead.store_executor.stop()
         self.replicas[i] = None
 
     def restart_replica(self, i: int) -> None:
@@ -320,8 +331,12 @@ class Cluster:
                 break
             if r in self.replicas:  # replaced/crashed replicas are dropped
                 cb()
-        if self.overlap and any(
-            r is not None and r._staged for r in self.replicas
+        if (self.overlap or self.store_async) and any(
+            r is not None
+            and (r._staged or (
+                r.store_executor is not None and not r.store_executor.idle
+            ))
+            for r in self.replicas
         ):
             # Yield the GIL so the executor threads actually run: the sim
             # loop never blocks, and a starved stage would look like a
@@ -358,16 +373,21 @@ class Cluster:
         raise TimeoutError(f"condition not reached in {max_ticks} ticks")
 
     def quiesce(self) -> None:
-        """Drain every replica's commit stage and apply completions (the
-        checkers read commit_min / state-machine state)."""
+        """Drain every replica's commit AND store stage and apply
+        completions (the checkers read commit_min / state-machine /
+        trailer state)."""
         for r in self.replicas:
             if r is not None and r.executor is not None:
                 r._quiesce_commit_stage()
+            if r is not None and r.store_executor is not None:
+                r._quiesce_store_stage()
 
     def close(self) -> None:
         for r in self.replicas:
             if r is not None and r.executor is not None:
                 r.executor.stop()
+            if r is not None and r.store_executor is not None:
+                r.store_executor.stop()
 
     # --- checkers -------------------------------------------------------
 

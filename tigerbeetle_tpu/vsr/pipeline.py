@@ -1,4 +1,5 @@
-"""Overlapped commit stage: state-machine execution off the event loop.
+"""Overlapped pipeline stages: commit execution and the deferred LSM
+store off the event loop.
 
 The serial replica commits inline — the asyncio event loop parses a
 request, writes the WAL, executes the state machine, stores, and only
@@ -184,3 +185,198 @@ class CommitExecutor:
             with self._cond:
                 self._busy = False
                 self._cond.notify_all()
+
+
+class StoreExecutor:
+    """Deferred LSM store stage: per-op coalesced groove/index write jobs
+    plus compaction beats, drained strictly in op order on one worker
+    thread (the WalWriter/CommitExecutor pattern, third stage).
+
+    Store durability is a pure function of the committed batch, so it can
+    trail commit order without touching determinism: the worker preserves
+    the serial apply sequence store(N) → beat(N) → store(N+1) → …, which
+    is the only thing grid allocation order (and therefore checkpoint
+    bytes) depends on. Readers synchronize through `drain()` — the state
+    machine's `store_barrier()` — before consulting anything the queued
+    jobs will write (read-your-writes).
+
+    Protocol with the replica:
+
+      - `process(job) -> Optional[dict]`: run one job on the worker; None
+        on success, the job itself (fault attached) on a `GridReadFault`
+        — the stage PARKS, the job is published on the done deque, and
+        `fault` exposes the exception so a reader blocked in `drain()`
+        can re-raise it instead of reading half-stored state.
+      - `submit()` applies backpressure: it blocks while the queue is at
+        `depth_max` (bounds job RAM) — but never while parked; the
+        replica's commit gates (`_finish_pending`) take over there.
+      - `resume(job)` requeues the repaired faulted job at the HEAD and
+        unparks (grid-repair recovery); `reset()` discards the queue
+        outright (state sync replaced the state machine wholesale).
+
+    Fail-stop discipline matches the other stages: any non-GridReadFault
+    exception posts a poison callback so the event loop crashes loudly.
+    """
+
+    DEPTH_MAX = 8  # queued store jobs (~1 MiB of records each, worst case)
+
+    def __init__(
+        self,
+        process: Callable[[dict], Optional[dict]],
+        post: Callable[[Callable[[], None]], None],
+        notify: Optional[Callable[[], None]] = None,
+        depth_max: int = DEPTH_MAX,
+    ) -> None:
+        self._process = process
+        self._post = post
+        self._notify = notify if notify is not None else (lambda: None)
+        self._depth_max = depth_max
+        self._cond = threading.Condition()
+        self._pending: deque = deque()
+        self._done: deque = deque()
+        # The job popped for processing (in-flight): part of the pending
+        # write buffer until its store phase lands (job["stored"]).
+        self._current: Optional[dict] = None
+        self._busy = False
+        self._parked = False
+        self._stopped = False
+        self.fault: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._run, name="store-executor", daemon=True
+        )
+        self._thread.start()
+
+    # --- producer side (commit thread / event loop) ----------------------
+
+    def submit(self, job: dict) -> None:
+        with self._cond:
+            while (
+                len(self._pending) >= self._depth_max
+                and not self._parked
+                and not self._stopped
+            ):
+                self._cond.wait()
+            if self._stopped:
+                # Shutdown race: the commit executor may settle its last
+                # in-flight run after stop() was issued. Dropping the job
+                # is safe — the WAL holds the committed prepares, and
+                # replay re-derives the store deterministically at the
+                # next open().
+                return
+            self._pending.append(job)
+            self._cond.notify_all()
+
+    def drain(self) -> None:
+        """Block until every queued job ran, or the stage parked on a
+        fault (check `parked`/`fault` after — a parked stage holds jobs
+        that will resume after grid repair)."""
+        with self._cond:
+            while (self._pending or self._busy) and not self._parked:
+                if self._stopped:
+                    raise RuntimeError(
+                        "store executor fail-stopped with jobs still queued"
+                    )
+                self._cond.wait()
+
+    def resume(self, job: dict) -> None:
+        """Requeue the repaired faulted job at the queue head and unpark."""
+        with self._cond:
+            self._pending.appendleft(job)
+            self._parked = False
+            self.fault = None
+            self._cond.notify_all()
+
+    def reset(self) -> List[dict]:
+        """Discard every queued job and unpark (state sync: the installed
+        checkpoint supersedes whatever the jobs would have stored). Waits
+        for an in-flight job to finish first — it must not still be
+        mutating the state machine the caller is about to replace."""
+        with self._cond:
+            out = list(self._pending)
+            self._pending.clear()  # first: the worker must not pop more
+            while self._busy and not self._stopped:
+                self._cond.wait()
+            self._done.clear()
+            self._parked = False
+            self.fault = None
+            self._cond.notify_all()
+        return out
+
+    def pop_done(self) -> Optional[dict]:
+        try:
+            return self._done.popleft()
+        except IndexError:
+            return None
+
+    def unapplied_stores(self) -> List[tuple]:
+        """Snapshot of the PENDING WRITE BUFFER: (recs, ts) store
+        payloads of queued + in-flight jobs whose index/log writes have
+        not landed yet. Readers racing the stage consult this first,
+        then the durable index — a job leaves this list only AFTER its
+        store phase completed (process sets job["stored"] before its
+        beat), so every committed write is visible in at least one of
+        the two at any instant (read-your-writes without a drain)."""
+        with self._cond:
+            jobs = list(self._pending)
+            if self._current is not None:
+                jobs.insert(0, self._current)
+        return [
+            j["store"] for j in jobs
+            if j.get("store") is not None and not j.get("stored")
+        ]
+
+    @property
+    def parked(self) -> bool:
+        return self._parked
+
+    @property
+    def idle(self) -> bool:
+        with self._cond:
+            return not self._pending and not self._busy and not self._parked
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+
+    # --- worker-thread side ----------------------------------------------
+
+    def _poison(self, err: BaseException) -> None:
+        def _raise() -> None:
+            raise RuntimeError(f"store executor stage failed: {err!r}") from err
+
+        self._post(_raise)
+        with self._cond:
+            self._stopped = True
+            self._busy = False
+            self._cond.notify_all()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while (not self._pending or self._parked) and not self._stopped:
+                    self._cond.wait()
+                if self._stopped:
+                    return
+                job = self._pending.popleft()
+                self._current = job
+                self._busy = True
+                self._cond.notify_all()  # submit()'s backpressure wait
+            try:
+                publish = self._process(job)
+            except Exception as e:  # noqa: BLE001 — fail-stop, never wedge
+                self._poison(e)
+                return
+            with self._cond:
+                self._current = None
+                if publish is not None:
+                    # Park + publish in ONE lock scope (CommitExecutor's
+                    # discipline): any thread observing parked also finds
+                    # the fault set, and drain() wakes to re-raise it.
+                    self._done.append(publish)
+                    self._parked = True
+                    self.fault = publish.get("fault")
+                self._busy = False
+                self._cond.notify_all()
+            if publish is not None:
+                self._post(self._notify)

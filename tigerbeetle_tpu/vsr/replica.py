@@ -282,6 +282,15 @@ class Replica:
         # keeps pumping sockets/prepare_oks/heartbeats. None = serial
         # inline commits (tests, deterministic simulator).
         self.executor = None
+        # Optional deferred-store stage (vsr/pipeline.StoreExecutor, wired
+        # via attach_store_executor): after an op's reply is posted, its
+        # groove/index writes and compaction beat run as a coalesced job
+        # on a dedicated thread, strictly in op order. None = store+beat
+        # inline in _finish_commit (tests, deterministic simulator).
+        self.store_executor = None
+        # The faulted store job parked on the stage, held for resubmission
+        # once its grid repair completes (the job resumes, never re-runs).
+        self._store_resume: Optional[dict] = None
         # Jobs handed to the stage but not yet completion-applied, in op
         # order. commit_min advances only as completions are applied.
         self._staged: List[dict] = []
@@ -1179,6 +1188,86 @@ class Replica:
             notify=self._drain_stage_completions,
         )
 
+    # --- deferred LSM store stage (vsr/pipeline.StoreExecutor) ----------
+    #
+    # Store durability is a pure function of the committed batch: once the
+    # reply is out, the op's groove/index writes and its compaction beat
+    # can trail commit order on a dedicated thread, as long as jobs drain
+    # strictly in op order (grid allocation order — and therefore
+    # checkpoint bytes — depends on nothing else). Reads synchronize via
+    # StateMachine.store_barrier() (drain-before-read = read-your-writes);
+    # checkpoint, state-sync, and block-serve paths quiesce the stage.
+
+    def attach_store_executor(
+        self, post: Callable[[Callable[[], None]], None]
+    ) -> None:
+        """Wire the async store stage. `post` schedules a callback onto
+        the replica's event loop thread. Tests and the deterministic
+        simulator skip this: store_executor=None keeps store+beat inline
+        in _finish_commit."""
+        from tigerbeetle_tpu.vsr.pipeline import StoreExecutor
+
+        assert self.store_executor is None
+        self.store_executor = StoreExecutor(
+            process=self._store_process,
+            post=post,
+            notify=self._drain_store_faults,
+        )
+        self.state_machine.attach_store_stage(self.store_executor)
+
+    def _store_process(self, job: dict) -> Optional[dict]:
+        """Worker-thread side: apply one op's coalesced store job, then
+        its compaction beat — the exact serial _finish_commit sequence.
+        Returns None on success, or the job (fault attached) to park the
+        stage on a GridReadFault (corrupt compaction input): the loop
+        repairs the block and `resume()`s the SAME job, which skips its
+        already-applied store phase and re-enters the beat at the faulted
+        stage (sm._beat_stage) — identical to the serial retry."""
+        sm = self.state_machine
+        try:
+            with tracer.span("stage.store_async"):
+                store = job.get("store")
+                if store is not None and not job.get("stored"):
+                    recs, ts = store
+                    with tracer.span("sm.ct.store"):
+                        sm._store_new_transfers(recs, ts=ts, add_bloom=False)
+                    job["stored"] = True
+                # flush=False: this job's store was applied above; the
+                # live _deferred_store (if any) is the NEXT op's batch,
+                # owned by the commit thread until its own job captures
+                # it — it must not be flushed from this thread.
+                sm.compact_beat(flush=False)
+        except GridReadFault as fault:
+            job["fault"] = fault
+            return job
+        return None
+
+    def _drain_store_faults(self) -> None:
+        """Loop-side fault drainer (the stage's notify): a parked store
+        job gates commits exactly like a serial finish-phase fault —
+        _finish_pending up, grid repair started, the job held for
+        resumption after the block is rewritten."""
+        se = self.store_executor
+        if se is None:
+            return
+        while True:
+            job = se.pop_done()
+            if job is None:
+                return
+            self._store_resume = job
+            self._finish_pending = True
+            self._begin_grid_repair(job["fault"])
+
+    def _quiesce_store_stage(self) -> bool:
+        """Drain the async store stage (cheap no-op when idle). False
+        when it parked on a fault — grid/store state is then incomplete
+        and the caller must not read it (repair is in flight)."""
+        se = self.store_executor
+        if se is None:
+            return True
+        se.drain()
+        return not se.parked
+
     def _stage_can_submit(self) -> bool:
         if self._stage_quiescing or len(self._staged) >= self.STAGE_QUEUE_MAX:
             return False
@@ -1562,6 +1651,8 @@ class Replica:
         if cached is not None and cached[0] == st.op_checkpoint:
             return cached
         self._quiesce_commit_stage()  # trailer blocks are grid reads
+        if not self._quiesce_store_stage():
+            return None  # store stage parked on a fault: grid incomplete
         try:
             blob = self._trailer_read(st.trailer_block)
         except IOError:
@@ -1717,6 +1808,12 @@ class Replica:
         # The install replaces the state machine wholesale: the executor
         # must not be mid-op against the old one.
         self._quiesce_commit_stage()
+        if self.store_executor is not None:
+            # Queued store jobs write state the installed checkpoint
+            # already covers wholesale: discard them (and any parked
+            # fault) — the new trees restore from the blob.
+            self.store_executor.reset()
+            self._store_resume = None
         # A state sync supersedes any in-flight normal-operation grid
         # repair: the installed checkpoint replaces the state the faulted
         # op would have produced, so the repair gates (and any half-done
@@ -1736,6 +1833,8 @@ class Replica:
         self.state_machine = StateMachine(
             self.config, backend=self.sm_backend, grid=grid
         )
+        if self.store_executor is not None:
+            self.state_machine.attach_store_stage(self.store_executor)
         # The client table is replicated state — it must exactly match the
         # installed checkpoint, so sessions from before the sync are dropped.
         self.clients = {}
@@ -1864,9 +1963,13 @@ class Replica:
         verifies each payload against its wanted checksum, so serving a
         since-reused block is harmless (re-requested elsewhere)."""
         peer = msg.header["replica"]
-        # Serving reads the grid the executor may be compacting into —
-        # settle the stage first (cheap when the stage is empty).
+        # Serving reads the grid the executors may be compacting into —
+        # settle both stages first (cheap when they are empty). A parked
+        # store stage means our own grid is mid-repair: do not serve, the
+        # peer re-requests elsewhere.
         self._quiesce_commit_stage()
+        if not self._quiesce_store_stage():
+            return
         indices = np.frombuffer(msg.body, dtype=np.uint32)
         grid = self.state_machine.grid
         for b in indices[: self.BLOCKS_PER_REQUEST]:
@@ -2003,7 +2106,14 @@ class Replica:
         log.info("replica %d: grid repair complete", self.replica)
         tracer.count("mark.grid_repair_done")
         self.on_event("grid_repair", self)
-        if self._finish_pending:
+        if self._store_resume is not None:
+            # The faulted async store job resumes on the stage thread at
+            # exactly the beat stage it parked in (sm._beat_stage); a
+            # second fault re-parks and the notify path re-gates.
+            job, self._store_resume = self._store_resume, None
+            self._finish_pending = False
+            self.store_executor.resume(job)
+        elif self._finish_pending:
             self._finish_pending = False
             try:
                 self._finish_commit()
@@ -2444,10 +2554,19 @@ class Replica:
         deferred object store, then the compaction beat. Runs AFTER the
         reply hits the wire (the reply depends only on validate+post) but
         in the identical per-op order as replay — store(N) → beat(N) →
-        anything of N+1 — so grid allocation order stays deterministic
-        across replicas and restarts (checked byte-for-byte by the
-        storage checker)."""
+        store(N+1) — so grid allocation order stays deterministic across
+        replicas and restarts (checked byte-for-byte by the storage
+        checker). With the async store stage attached, the same sequence
+        runs as a coalesced job on the store thread instead (jobs drain
+        strictly in op order, preserving the write sequence exactly);
+        submit() backpressure bounds the queue."""
         sm = self.state_machine
+        if self.store_executor is not None:
+            self.store_executor.submit({
+                "op": getattr(self, "last_committed_op", 0),
+                "store": sm.take_deferred_store(),
+            })
+            return
         sm.flush_deferred()
         sm.compact_beat()
 
@@ -2678,6 +2797,14 @@ class Replica:
             return
         log.info("replica %d: checkpoint at op %d", self.replica, self.commit_min)
         tracer.count("replica.checkpoint")
+        # The trailer must capture every op ≤ commit_min's store and beat:
+        # drain the async store stage first. A job parked on a corrupt
+        # block re-raises its fault here so _checkpoint_guarded applies
+        # the identical gate/retry path as an inline checkpoint fault.
+        if self.store_executor is not None:
+            self.store_executor.drain()
+            if self.store_executor.parked:
+                raise self.store_executor.fault
         if self.aof is not None:
             self.aof.sync()
         # Trailer write flushes LSM memtables into grid blocks and chunks

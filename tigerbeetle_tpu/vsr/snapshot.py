@@ -164,7 +164,8 @@ def encode(replica) -> bytes:
     of them except per-replica client reply seals).
     """
     sm = replica.state_machine
-    sm.flush_deferred()  # a deferred store must never miss a checkpoint
+    # A deferred (or async-queued) store must never miss a checkpoint.
+    sm.store_barrier()
     count = sm.account_count
     dp, dpo, cp, cpo = sm._read_balances(np.arange(count, dtype=np.int64))
 

@@ -1,6 +1,7 @@
-"""Bench regression gate: compare a fresh `bench.py` end_to_end block
-against the latest recorded round benchmark (BENCH_r*.json) and fail on
-a >10% regression in accepted throughput or client-perceived p50.
+"""Bench regression gate: compare a fresh `bench.py` run against the
+latest recorded round benchmark (BENCH_r*.json) and fail on a >10%
+regression in the e2e metrics (accepted throughput, client-perceived
+p50) or the LSM store metrics (config5 ingest / major-compaction rates).
 
 Usage:
     python bench.py | tee /tmp/bench.json
@@ -33,14 +34,19 @@ THROUGHPUT_REGRESSION = 0.10
 LATENCY_REGRESSION = 0.10
 
 GATED = (
-    # (key, higher_is_better)
-    ("load_accepted_tx_per_s", True),
-    ("perceived_p50_ms", False),
+    # (section, key, higher_is_better). Sections are blocks of bench.py's
+    # `extra` dict; end_to_end guards the serving path, config5_lsm the
+    # store tier (the async store stage moved its cost off the commit
+    # path — this keeps the work itself from silently regressing).
+    ("end_to_end", "load_accepted_tx_per_s", True),
+    ("end_to_end", "perceived_p50_ms", False),
+    ("config5_lsm", "ingest_rows_per_s", True),
+    ("config5_lsm", "major_compaction_rows_per_s", True),
 )
 
 
-def latest_round_e2e() -> tuple:
-    """(round, end_to_end block) from the newest BENCH_r*.json."""
+def latest_round_extra() -> tuple:
+    """(round, extra dict) from the newest BENCH_r*.json."""
     rounds = []
     for path in glob.glob(os.path.join(REPO, "BENCH_r*.json")):
         m = re.search(r"BENCH_r(\d+)\.json$", path)
@@ -52,15 +58,16 @@ def latest_round_e2e() -> tuple:
     with open(path) as f:
         rec = json.load(f)
     parsed = rec.get("parsed") or rec  # raw bench JSON also accepted
-    e2e = (parsed.get("extra") or {}).get("end_to_end")
-    if e2e is None or "load_accepted_tx_per_s" not in e2e:
+    extra = parsed.get("extra")
+    if not isinstance(extra, dict) or "end_to_end" not in extra:
         return n, None
-    return n, e2e
+    return n, extra
 
 
-def extract_e2e(text: str):
-    """Pull the end_to_end block out of bench.py's output (the JSON line
-    may be surrounded by warnings/log noise)."""
+def extract_extra(text: str):
+    """Pull the bench `extra` blocks out of bench.py's output (the JSON
+    line may be surrounded by warnings/log noise). A bare end_to_end
+    block is accepted too (wrapped as {"end_to_end": block})."""
     for line in text.splitlines():
         line = line.strip()
         if not line.startswith("{"):
@@ -69,11 +76,11 @@ def extract_e2e(text: str):
             rec = json.loads(line)
         except json.JSONDecodeError:
             continue
-        e2e = (rec.get("extra") or {}).get("end_to_end")
-        if e2e is None and "load_accepted_tx_per_s" in rec:
-            e2e = rec  # a bare end_to_end block is fine too
-        if e2e is not None and "load_accepted_tx_per_s" in e2e:
-            return e2e
+        extra = rec.get("extra")
+        if isinstance(extra, dict) and "end_to_end" in extra:
+            return extra
+        if "load_accepted_tx_per_s" in rec:
+            return {"end_to_end": rec}
     return None
 
 
@@ -94,19 +101,32 @@ def main(argv=None) -> int:
     else:
         with open(args.current) as f:
             text = f.read()
-    current = extract_e2e(text)
+    current = extract_extra(text)
     if current is None:
         print("bench_gate: no end_to_end block in the input", file=sys.stderr)
         return 2
-    rnd, baseline = latest_round_e2e()
+    rnd, baseline = latest_round_extra()
     if baseline is None:
         print("bench_gate: no BENCH_r*.json baseline found — recording only")
 
     failed = []
     rows = []
-    for key, higher_better in GATED:
-        cur = float(current[key])
-        base = float(baseline[key]) if baseline and key in baseline else None
+    for section, key, higher_better in GATED:
+        cur_sec = current.get(section) or {}
+        base_sec = (baseline.get(section) or {}) if baseline else {}
+        label = f"{section}.{key}"
+        if key not in cur_sec:
+            # A section the current run skipped/errored FAILS the gate
+            # whenever the baseline recorded it (a crashed bench must
+            # not pass as "no regression"); with no baseline either,
+            # there is nothing to compare (n/a).
+            base = float(base_sec[key]) if key in base_sec else None
+            if base is not None:
+                failed.append(label)
+            rows.append((label, None, base, "MISSING" if base is not None else "n/a"))
+            continue
+        cur = float(cur_sec[key])
+        base = float(base_sec[key]) if key in base_sec else None
         verdict = "n/a"
         if base is not None and base > 0:
             if higher_better:
@@ -117,14 +137,15 @@ def main(argv=None) -> int:
                 ok = cur <= limit
             verdict = "ok" if ok else "REGRESSION"
             if not ok:
-                failed.append(key)
-        rows.append((key, cur, base, verdict))
+                failed.append(label)
+        rows.append((label, cur, base, verdict))
 
     width = max(len(k) for k, *_ in rows)
     print(f"bench gate vs BENCH_r{rnd:02d}.json (>10% regression fails):")
-    for key, cur, base, verdict in rows:
+    for label, cur, base, verdict in rows:
+        cur_s = f"{cur:,.1f}" if cur is not None else "—"
         base_s = f"{base:,.1f}" if base is not None else "—"
-        print(f"  {key:{width}s}  current={cur:,.1f}  baseline={base_s}  {verdict}")
+        print(f"  {label:{width}s}  current={cur_s}  baseline={base_s}  {verdict}")
 
     try:
         from tigerbeetle_tpu import tracer
@@ -135,9 +156,15 @@ def main(argv=None) -> int:
             "unit": "fail_count",
             "extra": {
                 "baseline_round": rnd,
-                "current": {k: current.get(k) for k, _ in GATED},
+                "current": {
+                    f"{s}.{k}": (current.get(s) or {}).get(k)
+                    for s, k, _ in GATED
+                },
                 "baseline": (
-                    {k: baseline.get(k) for k, _ in GATED} if baseline else None
+                    {
+                        f"{s}.{k}": (baseline.get(s) or {}).get(k)
+                        for s, k, _ in GATED
+                    } if baseline else None
                 ),
                 "failed": failed,
             },
