@@ -658,8 +658,10 @@ def bench_e2e():
             "load_accepted_tx_per_s": r"load accepted = ([\d,]+) tx/s",
             "batch_p50_ms": r"batch latency p50 = ([\d.]+) ms",
             "batch_p90_ms": r"batch latency p90 = ([\d.]+) ms",
+            "batch_p99_ms": r"batch latency p99 = ([\d.]+) ms",
             "perceived_p50_ms": r"client-perceived p50 = ([\d.]+) ms",
             "perceived_p90_ms": r"client-perceived p90 = ([\d.]+) ms",
+            "perceived_p99_ms": r"client-perceived p99 = ([\d.]+) ms",
             "query_p90_ms": r"query latency p90 = ([\d.]+) ms",
         }
         for line in proc.stdout.splitlines():

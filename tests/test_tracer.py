@@ -1,27 +1,37 @@
-"""Tracer spans/counters + devhub series (reference tracer.zig, statsd.zig,
-devhub.zig analogs)."""
+"""Observability subsystem: per-thread span rings, latency histograms,
+Perfetto export, Prometheus scrape, devhub series (reference tracer.zig,
+statsd.zig, devhub.zig analogs)."""
 
+import asyncio
 import json
+import re
+import threading
+
+import pytest
 
 from tigerbeetle_tpu import tracer
 
 
-def test_span_aggregation():
+@pytest.fixture
+def traced():
+    """Enabled tracer with clean state; disabled + cleared afterwards."""
     tracer.reset()
     tracer.enable()
-    try:
-        for _ in range(3):
-            with tracer.span("unit.work"):
-                pass
-        tracer.count("unit.events", 5)
-        snap = tracer.snapshot()
-        assert snap["unit.work"]["count"] == 3
-        assert snap["unit.work"]["total_ms"] >= 0
-        assert snap["unit.events"]["count"] == 5
-        json.loads(tracer.emit_json())  # valid JSON
-    finally:
-        tracer.disable()
-        tracer.reset()
+    yield
+    tracer.disable()
+    tracer.reset()
+
+
+def test_span_aggregation(traced):
+    for _ in range(3):
+        with tracer.span("unit.work"):
+            pass
+    tracer.count("unit.events", 5)
+    snap = tracer.snapshot()
+    assert snap["unit.work"]["count"] == 3
+    assert snap["unit.work"]["total_ms"] >= 0
+    assert snap["unit.events"]["count"] == 5
+    json.loads(tracer.emit_json())  # valid JSON
 
 
 def test_disabled_is_free_of_state():
@@ -33,25 +43,227 @@ def test_disabled_is_free_of_state():
     assert tracer.snapshot() == {}
 
 
-def test_spans_capture_commit_pipeline():
-    """Driving a replica with tracing on records the pipeline events."""
+def test_disabled_path_is_allocation_free():
+    """TIGERBEETLE_TPU_TRACE=0 must keep the hot path allocation-free:
+    span() returns a singleton null context, count()/gauge() return on
+    the flag check."""
+    import gc
+    import sys
+
+    tracer.disable()
     tracer.reset()
+    for _ in range(16):  # warm any lazy interning
+        with tracer.span("warm"):
+            pass
+        tracer.count("warm")
+        tracer.gauge("warm", 1)
+    gc.collect()
+    before = sys.getallocatedblocks()
+    for _ in range(2000):
+        with tracer.span("never"):
+            pass
+        tracer.count("never")
+        tracer.gauge("never", 1)
+    delta = sys.getallocatedblocks() - before
+    assert delta < 32, f"disabled tracer allocated {delta} blocks"
+    assert tracer.snapshot() == {}
+
+
+def test_histogram_bucket_roundtrip():
+    """bucket_value(bucket_index(v)) within one sub-bucket (12.5%) of v,
+    and bucket_index is monotone."""
+    prev = -1
+    for exp in range(0, 50):
+        for v in (1 << exp, (1 << exp) + (1 << max(0, exp - 1))):
+            idx = tracer.bucket_index(v)
+            assert 0 <= idx < tracer.HIST_BUCKETS
+            assert idx >= prev or v < 1 << exp
+            rep = tracer.bucket_value(idx)
+            assert abs(rep - v) <= max(1, v / (1 << tracer.HIST_SUB_BITS)), (
+                v, idx, rep,
+            )
+    vals = [tracer.bucket_index(v) for v in range(0, 5000)]
+    assert vals == sorted(vals)
+
+
+def test_histogram_percentiles_known_distribution(traced):
+    # Uniform 1..1000 µs: p50 ≈ 500 µs, p95 ≈ 950 µs, p99 ≈ 990 µs
+    # (bucket quantization bounds the error at 12.5%).
+    for v in range(1, 1001):
+        tracer.observe("h.uniform", v * 1000)
+    rec = tracer.snapshot()["h.uniform"]
+    assert rec["count"] == 1000
+    for key, expect in (("p50_us", 500), ("p95_us", 950), ("p99_us", 990)):
+        assert abs(rec[key] - expect) / expect < 0.15, (key, rec)
+    assert rec["max_us"] >= 999
+    # A constant distribution: every percentile in the value's bucket.
+    for _ in range(100):
+        tracer.observe("h.const", 123_000)
+    rec = tracer.snapshot()["h.const"]
+    for key in ("p50_us", "p95_us", "p99_us"):
+        assert abs(rec[key] - 123.0) / 123.0 < 0.13, (key, rec)
+
+
+def test_ring_buffer_wraparound():
+    tracer.configure(ring_size=16)  # implies reset
     tracer.enable()
     try:
-        from tigerbeetle_tpu.testing.cluster import Cluster, account_batch
-
-        from tests.test_cluster import do_request, setup_client
-        from tigerbeetle_tpu.vsr.header import Operation
-
-        cl = Cluster(replica_count=1)
-        c = setup_client(cl)
-        do_request(cl, c, Operation.CREATE_ACCOUNTS, account_batch([1, 2]))
+        for i in range(50):
+            tracer.observe(f"ring.{i}", 1000)
+        evs = [e for e in tracer.trace_events() if e[0].startswith("ring.")]
+        # Bounded at the ring capacity, holding exactly the LAST 16.
+        assert len(evs) == 16
+        assert {e[0] for e in evs} == {f"ring.{i}" for i in range(34, 50)}
+        # Aggregates are NOT ring-bounded: every record counted.
         snap = tracer.snapshot()
-        assert snap["replica.execute"]["count"] >= 1
-        assert snap["journal.write_prepare"]["count"] >= 1
+        assert sum(snap[f"ring.{i}"]["count"] for i in range(50)) == 50
     finally:
         tracer.disable()
-        tracer.reset()
+        tracer.configure(ring_size=tracer.RING_DEFAULT)
+
+
+def test_multithread_merge_exact_and_deterministic(traced):
+    """Counters bumped from worker threads merge exactly (the PR-1/2
+    latent race: the old flat dict lost increments), and snapshot() is
+    deterministic once writers quiesce."""
+    def work():
+        for _ in range(10_000):
+            tracer.count("mt.counter")
+        for _ in range(50):
+            with tracer.span("mt.span"):
+                pass
+
+    threads = [
+        threading.Thread(target=work, name=f"merge-w{i}") for i in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap1 = tracer.snapshot()
+    snap2 = tracer.snapshot()
+    assert snap1["mt.counter"]["count"] == 40_000
+    assert snap1["mt.span"]["count"] == 200
+    assert snap1 == snap2
+
+
+def test_perfetto_export_schema(traced):
+    with tracer.span("loop.work"):
+        pass
+
+    def worker():
+        with tracer.span("worker.work"):
+            pass
+
+    t = threading.Thread(target=worker, name="perfetto-worker")
+    t.start()
+    t.join()
+    doc = json.loads(json.dumps(tracer.export_trace()))  # JSON-clean
+    assert isinstance(doc["traceEvents"], list)
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {m["args"]["name"] for m in metas} >= {"MainThread", "perfetto-worker"}
+    names = {e["name"] for e in spans}
+    assert {"loop.work", "worker.work"} <= names
+    for e in spans:
+        assert set(e) >= {"name", "ph", "ts", "dur", "pid", "tid"}
+        assert e["dur"] >= 0
+    # Distinct threads → distinct track ids.
+    tid_of = {e["name"]: e["tid"] for e in spans}
+    assert tid_of["loop.work"] != tid_of["worker.work"]
+
+
+def test_trace_dump_and_summary_tool(tmp_path, traced):
+    import os
+    import subprocess
+    import sys
+
+    with tracer.span("dump.work"):
+        pass
+    path = tracer.dump(str(tmp_path / "trace.json"))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "trace_summary.py"), path],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "dump.work" in out.stdout
+    assert "thread overlap" in out.stdout
+
+
+def test_prometheus_text_parseable(traced):
+    with tracer.span("prom.span"):
+        pass
+    tracer.count("prom.counter", 7)
+    tracer.gauge("prom.gauge", 3.5)
+    text = tracer.prometheus_text()
+    line_re = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [0-9.eE+-]+$"
+    )
+    for line in text.strip().splitlines():
+        assert line.startswith("#") or line_re.match(line), line
+    assert 'tbtpu_span_seconds_count{event="prom.span"} 1' in text
+    assert 'tbtpu_span_seconds{event="prom.span",quantile="0.99"}' in text
+    assert 'tbtpu_events_total{event="prom.counter"} 7' in text
+    assert 'tbtpu_gauge{name="prom.gauge"} 3.5' in text
+
+
+def test_metrics_http_scrape(traced):
+    """GET /metrics returns Prometheus text, /trace returns Perfetto
+    JSON, unknown paths 404 — served from the asyncio loop."""
+    with tracer.span("scrape.span"):
+        pass
+    tracer.count("scrape.counter")
+
+    async def fetch(port, path):
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(f"GET {path} HTTP/1.1\r\nHost: t\r\n\r\n".encode())
+        await writer.drain()
+        data = await reader.read()
+        writer.close()
+        return data
+
+    async def go():
+        server = await tracer.serve_metrics(0)
+        port = server.sockets[0].getsockname()[1]
+        try:
+            return (
+                await fetch(port, "/metrics"),
+                await fetch(port, "/trace"),
+                await fetch(port, "/nope"),
+            )
+        finally:
+            server.close()
+            await server.wait_closed()
+
+    metrics, trace, nope = asyncio.run(go())
+    head, _, body = metrics.partition(b"\r\n\r\n")
+    assert head.startswith(b"HTTP/1.1 200")
+    assert b"tbtpu_span_seconds_count" in body
+    assert b'event="scrape.counter"' in body
+    head, _, body = trace.partition(b"\r\n\r\n")
+    assert head.startswith(b"HTTP/1.1 200")
+    doc = json.loads(body)
+    assert any(e["name"] == "scrape.span" for e in doc["traceEvents"])
+    assert nope.startswith(b"HTTP/1.1 404")
+
+
+def test_spans_capture_commit_pipeline(traced):
+    """Driving a replica with tracing on records the pipeline events,
+    including the new registry counters."""
+    from tigerbeetle_tpu.testing.cluster import Cluster, account_batch
+
+    from tests.test_cluster import do_request, setup_client
+    from tigerbeetle_tpu.vsr.header import Operation
+
+    cl = Cluster(replica_count=1)
+    c = setup_client(cl)
+    do_request(cl, c, Operation.CREATE_ACCOUNTS, account_batch([1, 2]))
+    snap = tracer.snapshot()
+    assert snap["replica.execute"]["count"] >= 1
+    assert snap["journal.write_prepare"]["count"] >= 1
+    assert snap["vsr.commits"]["count"] >= 1
+    assert "p99_us" in snap["replica.execute"]
 
 
 def test_devhub_append(tmp_path):
@@ -62,3 +274,7 @@ def test_devhub_append(tmp_path):
     assert len(lines) == 2
     assert all("unix_timestamp" in r for r in lines)
     assert lines[1]["value"] == 2
+    # Every row carries the git revision stamp (commit attribution);
+    # this checkout is a git repo, so it must be a real short SHA.
+    assert all("git" in r for r in lines)
+    assert re.fullmatch(r"[0-9a-f]{4,40}", lines[0]["git"])

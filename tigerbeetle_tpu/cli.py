@@ -146,10 +146,26 @@ def cmd_start(args) -> int:
     replica.open()
     host, port = addresses[args.replica]
 
+    from tigerbeetle_tpu import tracer
+
+    if args.metrics_port:
+        # The scrape surface implies recording: a /metrics endpoint over
+        # a disabled registry would serve an empty page forever.
+        tracer.enable()
+
     async def _serve() -> None:
         # Bind BEFORE announcing: tooling (benchmark driver, scripts) waits
         # for this line and connects immediately.
         await server.start()
+        metrics_server = None
+        if args.metrics_port:
+            # /metrics (Prometheus text) + /trace (Perfetto JSON) on the
+            # replica's own event loop — a scrape observes the live
+            # registry, no extra thread. The reference is held for the
+            # server's lifetime (a dropped asyncio.Server may be GC'd).
+            metrics_server = await tracer.serve_metrics(args.metrics_port)
+            print(f"metrics on http://127.0.0.1:{args.metrics_port}/metrics "
+                  f"(trace: /trace)", flush=True)
         print(f"replica {args.replica}/{len(addresses)} listening on {host}:{port} "
               f"(backend={args.backend}, status={replica.status})", flush=True)
         await server.serve_forever()
@@ -159,8 +175,6 @@ def cmd_start(args) -> int:
     except KeyboardInterrupt:
         pass
     finally:
-        from tigerbeetle_tpu import tracer
-
         if tracer.enabled():
             print("TRACER " + tracer.emit_json(), file=sys.stderr, flush=True)
     return 0
@@ -404,17 +418,33 @@ def cmd_benchmark(args) -> int:
             rng = np.random.default_rng(0xBEE)
             lat.sort()
             perceived.sort()
+            # Fold the measured latencies into the tracer registry (when
+            # tracing is on) so a scrape or TRACER dump of this process
+            # reports the same numbers the driver prints — one source of
+            # truth, no second timing pass.
+            from tigerbeetle_tpu import tracer
+
+            if tracer.enabled():
+                for v in lat:
+                    tracer.observe("bench.batch_latency", int(v * 1e9))
+                for v in perceived:
+                    tracer.observe("bench.perceived_latency", int(v * 1e9))
+
+            def pct(sorted_vals, q):
+                return sorted_vals[min(len(sorted_vals) - 1,
+                                       int(len(sorted_vals) * q))]
+
             print(f"load accepted = {sent / dt:,.0f} tx/s")
-            print(f"batch latency p50 = {lat[len(lat) // 2] * 1e3:.2f} ms")
-            print(f"batch latency p90 = {lat[int(len(lat) * 0.9)] * 1e3:.2f} ms")
+            print(f"batch latency p50 = {pct(lat, 0.5) * 1e3:.2f} ms")
+            print(f"batch latency p90 = {pct(lat, 0.9) * 1e3:.2f} ms")
+            print(f"batch latency p99 = {pct(lat, 0.99) * 1e3:.2f} ms")
             # Client-perceived = submit() call → reply, including the time
             # the request queued for a free session. Meaningful under
             # --rate pacing; under --rate=0 flood it is an upper bound
             # (every batch is offered at t=0).
-            print(f"client-perceived p50 = "
-                  f"{perceived[len(perceived) // 2] * 1e3:.2f} ms")
-            print(f"client-perceived p90 = "
-                  f"{perceived[int(len(perceived) * 0.9)] * 1e3:.2f} ms")
+            print(f"client-perceived p50 = {pct(perceived, 0.5) * 1e3:.2f} ms")
+            print(f"client-perceived p90 = {pct(perceived, 0.9) * 1e3:.2f} ms")
+            print(f"client-perceived p99 = {pct(perceived, 0.99) * 1e3:.2f} ms")
 
             # Query phase (reference benchmark_load.zig: account queries
             # after the load; prints query latency p90).
@@ -503,6 +533,10 @@ def main(argv=None) -> int:
     s.add_argument("--serial-store", action="store_true",
                    help="disable the async LSM store stage (groove/index "
                         "writes + compaction beats inline after each op)")
+    s.add_argument("--metrics-port", type=int, default=0,
+                   help="serve /metrics (Prometheus text) and /trace "
+                        "(Perfetto JSON) on this port from the replica's "
+                        "event loop; implies tracing on")
     s.set_defaults(fn=cmd_start)
 
     a = sub.add_parser("aof", help="AOF debug/merge/recover tooling")

@@ -23,6 +23,7 @@ from typing import Optional
 
 import numpy as np
 
+from tigerbeetle_tpu import tracer
 from tigerbeetle_tpu.io import ewah
 from tigerbeetle_tpu.vsr.header import checksum as _checksum
 
@@ -223,6 +224,7 @@ class Grid:
         if kick is not None:
             kick(self._addr(index), self.block_size)
         self.writes += 1
+        tracer.count("grid.writes")
         self.block_cks[index] = c
         self._cache_put(index, bytes(payload))
         return index
@@ -241,6 +243,7 @@ class Grid:
         head["checksum_hi"] = c >> 64
         self.storage.write(self._addr(index), head.tobytes() + payload)
         self.writes += 1
+        tracer.count("grid.writes")
         self.block_cks[index] = c
         self._cache_put(index, bytes(payload))
 
@@ -255,14 +258,17 @@ class Grid:
             except KeyError:
                 pass  # concurrently evicted: the payload is still valid
             self.cache_hits += 1
+            tracer.count("grid.cache_hits")
             return cached
         raw = self.storage.read(self._addr(index), self.block_size)
         self.reads += 1
+        tracer.count("grid.reads")
         head = np.frombuffer(raw[:BLOCK_HEADER_SIZE], dtype=_BLOCK_HEADER_DTYPE)[0]
         size = int(head["size"])
         payload = raw[BLOCK_HEADER_SIZE : BLOCK_HEADER_SIZE + size]
         want = int(head["checksum_lo"]) | (int(head["checksum_hi"]) << 64)
         if size > self.payload_max or _checksum(payload) != want:
+            tracer.count("grid.read_faults")
             raise GridReadFault(index, self.block_cks.get(index))
         self._cache_put(index, payload)
         return payload

@@ -31,6 +31,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from tigerbeetle_tpu import tracer
 from tigerbeetle_tpu.io.grid import Grid, GridReadFault
 from tigerbeetle_tpu.lsm.store import (
     KEY_DTYPE,
@@ -194,9 +195,14 @@ class DurableIndex:
         memtable_max: int = 1 << 16,
         growth: int = 8,
         backend: str = "numpy",
+        name: Optional[str] = None,
     ) -> None:
         self.grid = grid
         self.unique = unique
+        # Metric identity: named trees publish tables-per-level gauges
+        # (`lsm.<name>.tables_l<N>`); anonymous trees skip the gauges but
+        # still feed the shared lsm.* counters.
+        self.name = name
         self.memtable_max = memtable_max
         self.growth = growth
         self.backend = backend
@@ -318,6 +324,13 @@ class DurableIndex:
         self._mem = []
         self._mem_sorted = []
         self._mem_count = 0
+        tracer.count("lsm.memtable_flushes")
+        self._publish_level_gauges()
+
+    def _publish_level_gauges(self) -> None:
+        if self.name is not None and tracer.enabled():
+            for lvl, tables in enumerate(self.levels):
+                tracer.gauge(f"lsm.{self.name}.tables_l{lvl}", len(tables))
 
     def _build_table(self, keys: np.ndarray, vals: np.ndarray) -> TableInfo:
         """Write sorted entries as data blocks + one index block."""
@@ -348,6 +361,7 @@ class DurableIndex:
             + fences.tobytes()
         )
         index_block = self.grid.write_block(index_payload, BLOCK_TYPE_INDEX)
+        tracer.count("lsm.table_builds")
         return TableInfo(
             index_block=index_block,
             count=n,
@@ -377,6 +391,7 @@ class DurableIndex:
         return keys, vals
 
     def _release_table(self, table: TableInfo) -> None:
+        tracer.count("lsm.table_retires")
         with self._lru_lock:
             table._released = True
             if table._decoded is not None:
@@ -426,6 +441,7 @@ class DurableIndex:
                         break
         if self._job is None:
             return False
+        tracer.count("lsm.compaction_beats")
         try:
             # A restored job's deferred fast-forward folds into this
             # step's quota (see restore_job) — same stopping point as a
@@ -476,6 +492,8 @@ class DurableIndex:
         ]
         for t in job.tables:
             self._release_table(t)
+        tracer.count("lsm.compaction_installs")
+        self._publish_level_gauges()
 
     def drain_compaction(self) -> None:
         """Run every queued compaction to completion (checkpoint barrier:
@@ -665,6 +683,9 @@ class DurableIndex:
                 if decoded is None and bloom is None:
                     bloom = self._stream_bloom(table)
             if bloom is not None:
+                traced = tracer.enabled()
+                if traced:
+                    tracer.count("lsm.bloom.probes", int(pending.sum()))
                 flagged = pending & bloom.maybe(keys["lo"], keys["hi"])
                 if not flagged.any():
                     continue
@@ -681,6 +702,14 @@ class DurableIndex:
                 else:
                     self._lookup_table(table, keys[ix], sub_out, sub_pending)
                 resolved = ix[~sub_pending]
+                if traced:
+                    # A flagged key the table does not hold is a bloom
+                    # false positive by definition (the filter is per-run).
+                    tracer.count("lsm.bloom.passes", len(ix))
+                    tracer.count("lsm.bloom.hits", len(resolved))
+                    tracer.count(
+                        "lsm.bloom.false_positives", len(ix) - len(resolved)
+                    )
                 out[resolved] = sub_out[~sub_pending]
                 pending[resolved] = False
                 continue

@@ -218,10 +218,12 @@ class StateMachine:
         self.transfer_index = DurableIndex(
             self.grid, unique=True,
             memtable_max=config.index_memtable_rows, backend=backend,
+            name="transfer_id",
         )
         self.account_rows = DurableIndex(
             self.grid, unique=False,
             memtable_max=config.index_memtable_rows, backend=backend,
+            name="account_rows",
         )
         # Combined secondary query index: (tag<<56 | fold56(field value),
         # timestamp) -> row, for the 8 indexed transfer fields beyond
@@ -230,6 +232,7 @@ class StateMachine:
         self.query_rows = DurableIndex(
             self.grid, unique=False,
             memtable_max=config.index_memtable_rows, backend=backend,
+            name="query_rows",
         )
         self.transfer_log = DurableLog(self.grid, types.TRANSFER_DTYPE)
         # Transfer-id membership pre-filter (no false negatives): keeps the
@@ -295,6 +298,7 @@ class StateMachine:
         instead of reading half-stored state."""
         stage = self._store_stage
         if stage is not None:
+            tracer.count("sm.store_barrier_drains")
             with tracer.span("sm.store.barrier"):
                 while True:
                     stage.drain()
@@ -363,6 +367,7 @@ class StateMachine:
         indexes, groove.zig:138). `ts` optionally overrides the stored
         timestamp column during the log's copy (zero-copy path: the
         caller's event array is not mutated)."""
+        tracer.count("sm.stored_transfers", len(recs))
         with tracer.span("sm.store.log"):
             rows = self.transfer_log.append_batch(recs, ts=ts)
             if add_bloom:

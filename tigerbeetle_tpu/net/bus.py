@@ -44,6 +44,15 @@ class _Conn:
     def __init__(self, writer: asyncio.StreamWriter) -> None:
         self.writer = writer
         self.dropped = 0
+        # Per-connection gauge identity (a single global would flap
+        # between unrelated transports); the name is prebuilt so the hot
+        # send path does no string formatting.
+        peer = writer.get_extra_info("peername")
+        self._sendq_gauge = (
+            f"bus.send_queue_bytes.{peer[0]}:{peer[1]}"
+            if isinstance(peer, tuple) and len(peer) >= 2
+            else "bus.send_queue_bytes.unknown"
+        )
 
     def _can_send(self, size: int, command: Optional[int] = None) -> bool:
         """Backpressure guard: drop (and count) when the peer's send
@@ -56,11 +65,13 @@ class _Conn:
             if command in self._CONTROL else self.SEND_BUFFER_MAX
         )
         transport = self.writer.transport
-        if (
-            transport is not None
-            and transport.get_write_buffer_size() + size > limit
-        ):
+        buffered = (
+            transport.get_write_buffer_size() if transport is not None else 0
+        )
+        tracer.gauge(self._sendq_gauge, buffered)
+        if transport is not None and buffered + size > limit:
             self.dropped += 1
+            tracer.count("bus.dropped_messages")
             if self.dropped == 1 or self.dropped % 1000 == 0:
                 log.warning(
                     "send buffer full (peer stalled?): %d messages dropped "
@@ -72,6 +83,8 @@ class _Conn:
     def send(self, data: bytes) -> None:
         if self._can_send(len(data)):
             self.writer.write(data)
+            tracer.count("bus.tx_messages")
+            tracer.count("bus.tx_bytes", len(data))
 
     def send_message(self, msg: Message) -> None:
         """Frame a message without concatenating header+body (a ~1 MiB
@@ -80,6 +93,8 @@ class _Conn:
             self.writer.write(msg.header.to_bytes())
             if msg.body:
                 self.writer.write(msg.body)
+            tracer.count("bus.tx_messages")
+            tracer.count("bus.tx_bytes", HEADER_SIZE + len(msg.body))
 
 
 _algo_mismatch_logged = False
@@ -120,6 +135,9 @@ async def read_message(reader: asyncio.StreamReader) -> Optional[Message]:
     msg = Message(h, body)
     with tracer.span("stage.parse"):
         ok = h.valid_checksum_body(body)
+    if ok:
+        tracer.count("bus.rx_messages")
+        tracer.count("bus.rx_bytes", size)
     return msg if ok else None
 
 

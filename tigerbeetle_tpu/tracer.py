@@ -1,38 +1,194 @@
-"""Tracing, metrics, and the devhub-style benchmark series.
+"""Pipeline-wide tracing, metrics, and the devhub-style benchmark series.
 
-The analog of the reference's observability stack:
+The analog of the reference's observability stack, grown from the flat
+count/total/max table into a real subsystem now that three worker
+threads (WalWriter, CommitExecutor, StoreExecutor) overlap the event
+loop and their stall/idle time decides throughput:
 
-  - /root/reference/src/tracer.zig:48 — typed span events around the
-    commit pipeline (start/end pairs, slot-based). Here: `span(event)`
-    context manager aggregating count/total/max nanoseconds per event
-    name, near-zero overhead when disabled (one dict lookup + two
-    perf_counter_ns calls when enabled, nothing when not).
-  - /root/reference/src/statsd.zig:12 — metric emission. Here: `snapshot()`
-    returns the aggregate table; `emit_json()` renders one JSON object
-    (processes scrape it instead of UDP StatsD — no daemon dependency).
-  - /root/reference/src/scripts/devhub.zig:36-52 — the per-merge benchmark
-    time series. Here: `devhub_append(path, record)` appends one JSON line
-    with a wall-clock stamp; bench.py calls it so every bench run extends
-    a local `devhub.jsonl` database (the reference renders the same shape
-    with devhub.js).
+  - /root/reference/src/tracer.zig:48 — typed start/end span events.
+    Here: `span(event)` context manager writing one `(event, tid,
+    t_start, t_end)` record into a PER-THREAD bounded ring buffer
+    (lock-free: each thread owns its ring; steady-state cost is two
+    `perf_counter_ns` calls and zero allocation — span objects are
+    pooled, ring slots are preallocated arrays).
+  - HDR-style log-bucketed latency histograms per event (fixed bucket
+    array, 8 sub-buckets per octave ≈ 12.5% value resolution), so
+    `snapshot()` reports p50/p95/p99/max — not just averages.
+  - /root/reference/src/statsd.zig:12 — metric emission. Here: a
+    registry of counters (`count`) and gauges (`gauge`) merged across
+    threads; `prometheus_text()` renders the Prometheus text format and
+    `serve_metrics(port)` serves `/metrics` + `/trace` from the
+    replica's asyncio loop (scrape instead of UDP StatsD — no daemon).
+  - Chrome trace-event / Perfetto export: `export_trace()` merges every
+    thread's ring into one JSON object loadable in ui.perfetto.dev, so
+    the WAL/commit/store overlap is visible as an actual timeline;
+    `dump(path)` writes it for offline runs (profile_e2e).
+  - /root/reference/src/scripts/devhub.zig:36-52 — the per-merge
+    benchmark time series. Here: `devhub_append(path, record)` appends
+    one JSON line stamped with the wall clock AND the current git
+    revision, so every `devhub.jsonl` row is attributable to a commit.
 
-Spans are process-local and single-threaded (the replica is one event
-loop, like the reference); enable with TIGERBEETLE_TPU_TRACE=1 or
-`tracer.enable()`.
+Thread model: every recording path (span/count/observe) writes only
+thread-local state created lazily per thread and registered for merge;
+`snapshot()`/`trace_events()` read across threads without stopping
+them (merges are approximate only while writers are actively mid-
+record, exact once they quiesce). `reset()` bumps a generation counter
+— threads re-create state on their next record, so no cross-thread
+mutation ever races a writer. Enable with TIGERBEETLE_TPU_TRACE=1 or
+`tracer.enable()`; the disabled path is one module-global check and
+allocates nothing.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import threading
 import time
-from contextlib import contextmanager
-from typing import Dict
+from array import array
+from typing import Dict, List, Optional, Tuple
 
 _enabled = os.environ.get("TIGERBEETLE_TPU_TRACE", "") not in ("", "0")
 
-# event → [count, total_ns, max_ns]
-_events: Dict[str, list] = {}
+# --- histogram geometry (log-linear, HDR-lite) --------------------------
+#
+# Values are nanoseconds. 8 sub-buckets per power of two bound the
+# relative quantization error at 1/8 = 12.5%; 488 buckets cover the full
+# u64 range, so the array never saturates and merge = elementwise sum.
+
+HIST_SUB_BITS = 3
+_HIST_SUB = 1 << HIST_SUB_BITS
+HIST_BUCKETS = (64 - HIST_SUB_BITS) * _HIST_SUB
+_HIST_ZEROS = bytes(8 * HIST_BUCKETS)
+
+
+def bucket_index(v: int) -> int:
+    """Histogram bucket for a nanosecond value (v >= 0)."""
+    if v < _HIST_SUB:
+        return v
+    msb = v.bit_length() - 1
+    return ((msb - HIST_SUB_BITS + 1) << HIST_SUB_BITS) + (
+        (v >> (msb - HIST_SUB_BITS)) - _HIST_SUB
+    )
+
+
+def bucket_value(idx: int) -> int:
+    """Representative (midpoint) nanosecond value of a bucket."""
+    if idx < 2 * _HIST_SUB:
+        return idx
+    octave = idx >> HIST_SUB_BITS
+    sub = idx & (_HIST_SUB - 1)
+    shift = octave - 1  # = msb - HIST_SUB_BITS
+    lo = (_HIST_SUB + sub) << shift
+    return lo + ((1 << shift) - 1) // 2
+
+
+# --- per-thread recording state -----------------------------------------
+
+RING_DEFAULT = 1 << 15  # span records per thread (~0.75 MiB each)
+
+_ring_size = int(os.environ.get("TIGERBEETLE_TPU_TRACE_RING", RING_DEFAULT))
+_registry_lock = threading.Lock()
+_states: List["_ThreadState"] = []
+_generation = 0
+_gauges: Dict[str, float] = {}
+_tls = threading.local()
+
+
+class _ThreadState:
+    """One thread's private recording arena: aggregate table, histograms,
+    counters, span-object pool, and the bounded span ring (parallel
+    preallocated arrays — no allocation per record)."""
+
+    __slots__ = (
+        "gen", "tid", "name", "agg", "hist", "counters", "pool",
+        "ring_event", "ring_t0", "ring_t1", "ring_n", "ring_mask",
+    )
+
+    def __init__(self, gen: int, ring_size: int) -> None:
+        t = threading.current_thread()
+        self.gen = gen
+        self.tid = t.ident or 0
+        self.name = t.name
+        self.agg: Dict[str, list] = {}  # event -> [count, total_ns, max_ns]
+        self.hist: Dict[str, array] = {}
+        self.counters: Dict[str, int] = {}
+        self.pool: List[_Span] = []
+        self.ring_mask = ring_size - 1
+        self.ring_event: List[Optional[str]] = [None] * ring_size
+        self.ring_t0 = array("q", bytes(8 * ring_size))
+        self.ring_t1 = array("q", bytes(8 * ring_size))
+        self.ring_n = 0
+
+    def record(self, event: str, t0: int, t1: int) -> None:
+        dt = t1 - t0
+        agg = self.agg.get(event)
+        if agg is None:
+            agg = self.agg[event] = [0, 0, 0]
+            self.hist[event] = array("q", _HIST_ZEROS)
+        agg[0] += 1
+        agg[1] += dt
+        if dt > agg[2]:
+            agg[2] = dt
+        self.hist[event][bucket_index(dt if dt > 0 else 0)] += 1
+        i = self.ring_n & self.ring_mask
+        self.ring_event[i] = event
+        self.ring_t0[i] = t0
+        self.ring_t1[i] = t1
+        self.ring_n += 1
+
+
+def _state() -> _ThreadState:
+    st = getattr(_tls, "state", None)
+    while st is None or st.gen != _generation:
+        st = _ThreadState(_generation, _ring_size)
+        with _registry_lock:
+            # Registration is atomic with the generation check: a reset()
+            # that raced the state's creation already cleared the registry,
+            # and registering the stale arena would leak it (and its
+            # records) into every later snapshot. Rebuild against the new
+            # generation instead.
+            if st.gen == _generation:
+                _states.append(st)
+                _tls.state = st
+                break
+        st = None
+    return st
+
+
+class _Span:
+    """Reusable timed-region context manager (pooled per thread)."""
+
+    __slots__ = ("state", "event", "t0")
+
+    def __enter__(self) -> "_Span":
+        self.t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        state = self.state
+        state.record(self.event, self.t0, time.perf_counter_ns())
+        if len(state.pool) < 64:
+            state.pool.append(self)
+        return False
+
+
+class _NullSpan:
+    """Singleton no-op span: the disabled path allocates nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+# --- control ------------------------------------------------------------
 
 
 def enable() -> None:
@@ -50,51 +206,166 @@ def enabled() -> bool:
 
 
 def reset() -> None:
-    _events.clear()
+    """Discard every thread's recorded data and all gauges. Threads
+    re-create their state lazily (generation bump), so no cross-thread
+    mutation races an active writer; a span straddling the reset lands
+    in its old, now-unregistered arena and is dropped."""
+    global _generation
+    with _registry_lock:
+        _generation += 1
+        _states.clear()
+        _gauges.clear()
 
 
-@contextmanager
+def configure(ring_size: Optional[int] = None) -> None:
+    """Set the per-thread span-ring capacity (rounded up to a power of
+    two). Implies reset(): existing rings are discarded."""
+    global _ring_size
+    if ring_size is not None:
+        n = 1
+        while n < ring_size:
+            n <<= 1
+        _ring_size = n
+    reset()
+
+
+# --- recording ----------------------------------------------------------
+
+
 def span(event: str):
-    """Time a scoped region under `event` (tracer.zig start/end)."""
+    """Time a scoped region under `event` (tracer.zig start/end). Enabled
+    cost: two perf_counter_ns calls + one pooled object; disabled cost:
+    one flag check, zero allocation."""
     if not _enabled:
-        yield
+        return _NULL_SPAN
+    st = _state()
+    pool = st.pool
+    s = pool.pop() if pool else _Span()
+    s.state = st
+    s.event = event
+    return s
+
+
+def observe(event: str, duration_ns: int) -> None:
+    """Record an externally measured duration under `event` (ending now):
+    same aggregation/histogram/ring as a span — for callers that already
+    hold the two timestamps (stage idle/stall accounting, benchmark
+    latencies folded into the registry)."""
+    if not _enabled:
         return
-    t0 = time.perf_counter_ns()
-    try:
-        yield
-    finally:
-        dt = time.perf_counter_ns() - t0
-        rec = _events.get(event)
-        if rec is None:
-            _events[event] = [1, dt, dt]
-        else:
-            rec[0] += 1
-            rec[1] += dt
-            if dt > rec[2]:
-                rec[2] = dt
+    t1 = time.perf_counter_ns()
+    _state().record(event, t1 - duration_ns, t1)
 
 
 def count(event: str, n: int = 1) -> None:
-    """Bump a counter without timing (statsd.zig counter semantics)."""
+    """Bump a counter without timing (statsd.zig counter semantics).
+    Per-thread storage: exact under concurrent bumps from the WAL,
+    commit, and store threads."""
     if not _enabled:
         return
-    rec = _events.get(event)
-    if rec is None:
-        _events[event] = [n, 0, 0]
-    else:
-        rec[0] += n
+    st = _state()
+    st.counters[event] = st.counters.get(event, 0) + n
+
+
+def gauge(name: str, value: float) -> None:
+    """Set a last-write-wins gauge (queue depths, table counts)."""
+    if not _enabled:
+        return
+    _gauges[name] = value
+
+
+def gauges() -> Dict[str, float]:
+    return dict(_gauges)
+
+
+# --- merge / snapshot ---------------------------------------------------
+
+
+def _merged() -> Tuple[Dict[str, list], Dict[str, list], Dict[str, int]]:
+    """(agg, hist, counters) merged across every registered thread state.
+    Reads race active writers benignly: a concurrent insert can make one
+    retry; totals are exact once writers quiesce."""
+    agg: Dict[str, list] = {}
+    hists: Dict[str, list] = {}
+    counters: Dict[str, int] = {}
+    with _registry_lock:
+        states = list(_states)
+    for st in states:
+        for attempt in range(4):
+            try:
+                a_items = list(st.agg.items())
+                h_items = list(st.hist.items())
+                c_items = list(st.counters.items())
+                break
+            except RuntimeError:  # dict resized mid-iteration
+                if attempt == 3:
+                    a_items, h_items, c_items = [], [], []
+        for event, (n, total, mx) in a_items:
+            rec = agg.get(event)
+            if rec is None:
+                agg[event] = [n, total, mx]
+            else:
+                rec[0] += n
+                rec[1] += total
+                if mx > rec[2]:
+                    rec[2] = mx
+        for event, h in h_items:
+            merged = hists.get(event)
+            if merged is None:
+                hists[event] = list(h)
+            else:
+                for i, v in enumerate(h):
+                    if v:
+                        merged[i] += v
+        for event, n in c_items:
+            counters[event] = counters.get(event, 0) + n
+    return agg, hists, counters
+
+
+def _hist_percentile(buckets: list, total: int, q: float) -> int:
+    """q-quantile in nanoseconds from a merged bucket array."""
+    if total <= 0:
+        return 0
+    rank = q * (total - 1)
+    cum = 0
+    for i, c in enumerate(buckets):
+        if c:
+            cum += c
+            if cum > rank:
+                return bucket_value(i)
+    return bucket_value(HIST_BUCKETS - 1)
 
 
 def snapshot() -> Dict[str, dict]:
-    """event → {count, total_ms, avg_us, max_us}."""
-    out = {}
-    for event, (n, total, mx) in sorted(_events.items()):
-        out[event] = {
+    """event → {count, total_ms, avg_us, max_us, p50_us, p95_us, p99_us}
+    for spans; event → {count, total_ms: 0, ...} for bare counters.
+    Merged deterministically across every thread that recorded."""
+    agg, hists, counters = _merged()
+    out: Dict[str, dict] = {}
+    for event in sorted(agg):
+        n, total, mx = agg[event]
+        rec = {
             "count": n,
             "total_ms": round(total / 1e6, 3),
             "avg_us": round(total / n / 1e3, 1) if n else 0.0,
             "max_us": round(mx / 1e3, 1),
         }
+        h = hists.get(event)
+        if h is not None:
+            hn = sum(h)
+            rec["p50_us"] = round(_hist_percentile(h, hn, 0.50) / 1e3, 1)
+            rec["p95_us"] = round(_hist_percentile(h, hn, 0.95) / 1e3, 1)
+            rec["p99_us"] = round(_hist_percentile(h, hn, 0.99) / 1e3, 1)
+        out[event] = rec
+    for event in sorted(counters):
+        rec = out.get(event)
+        if rec is None:
+            out[event] = {
+                "count": counters[event], "total_ms": 0.0,
+                "avg_us": 0.0, "max_us": 0.0,
+            }
+        else:
+            rec["count"] += counters[event]
     return out
 
 
@@ -102,10 +373,194 @@ def emit_json() -> str:
     return json.dumps(snapshot())
 
 
+# --- timeline export (Chrome trace-event / Perfetto) --------------------
+
+
+def trace_events() -> List[tuple]:
+    """[(event, thread_name, tid, t0_ns, t1_ns)] merged across threads,
+    sorted by start time. Each thread contributes at most its ring
+    capacity (oldest records overwritten)."""
+    out: List[tuple] = []
+    with _registry_lock:
+        states = list(_states)
+    for st in states:
+        n = st.ring_n
+        size = st.ring_mask + 1
+        for j in range(max(0, n - size), n):
+            i = j & st.ring_mask
+            ev = st.ring_event[i]
+            if ev is not None:
+                out.append((ev, st.name, st.tid, st.ring_t0[i], st.ring_t1[i]))
+    out.sort(key=lambda r: r[3])
+    return out
+
+
+def export_trace() -> dict:
+    """Chrome trace-event JSON (the format ui.perfetto.dev and
+    chrome://tracing load): one complete event ('ph': 'X') per span
+    record, microsecond timestamps, plus thread-name metadata so the
+    loop/WAL/commit/store threads are labeled rows."""
+    pid = os.getpid()
+    evs: List[dict] = []
+    named: set = set()
+    for event, name, tid, t0, t1 in trace_events():
+        if tid not in named:
+            named.add(tid)
+            evs.append({
+                "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                "args": {"name": name},
+            })
+        evs.append({
+            "name": event, "cat": "tbtpu", "ph": "X", "pid": pid,
+            "tid": tid, "ts": t0 / 1e3, "dur": (t1 - t0) / 1e3,
+        })
+    return {"traceEvents": evs, "displayTimeUnit": "ms"}
+
+
+def dump(path: Optional[str] = None) -> str:
+    """Write the merged trace as Perfetto-loadable JSON; returns the
+    path (default: $TIGERBEETLE_TPU_TRACE_FILE or /tmp/tbtpu_trace.json)."""
+    if path is None:
+        path = os.environ.get(
+            "TIGERBEETLE_TPU_TRACE_FILE", "/tmp/tbtpu_trace.json"
+        )
+    with open(path, "w") as f:
+        json.dump(export_trace(), f)
+    return path
+
+
+# --- scrape surface (Prometheus text + HTTP) ----------------------------
+
+
+def _label_escape(s: str) -> str:
+    return s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def prometheus_text() -> str:
+    """The registry in Prometheus text exposition format: spans as
+    summaries (quantile series + _sum/_count), counters and gauges as
+    label-keyed families (event names carry dots, so they ride in
+    labels rather than metric names)."""
+    snap = snapshot()
+    spans = {e: r for e, r in snap.items() if "p50_us" in r}
+    counters = {e: r for e, r in snap.items() if "p50_us" not in r}
+    lines = [
+        "# HELP tbtpu_span_seconds Traced span latency by event.",
+        "# TYPE tbtpu_span_seconds summary",
+    ]
+    for e, r in spans.items():
+        lab = f'event="{_label_escape(e)}"'
+        for q, key in (("0.5", "p50_us"), ("0.95", "p95_us"), ("0.99", "p99_us")):
+            lines.append(
+                f'tbtpu_span_seconds{{{lab},quantile="{q}"}} {r[key] / 1e6:.9g}'
+            )
+        lines.append(f"tbtpu_span_seconds_sum{{{lab}}} {r['total_ms'] / 1e3:.9g}")
+        lines.append(f"tbtpu_span_seconds_count{{{lab}}} {r['count']}")
+    lines += [
+        "# HELP tbtpu_span_max_seconds Maximum observed span latency.",
+        "# TYPE tbtpu_span_max_seconds gauge",
+    ]
+    for e, r in spans.items():
+        lines.append(
+            f'tbtpu_span_max_seconds{{event="{_label_escape(e)}"}} '
+            f"{r['max_us'] / 1e6:.9g}"
+        )
+    lines += [
+        "# HELP tbtpu_events_total Counter registry (VSR/LSM/grid/bus marks).",
+        "# TYPE tbtpu_events_total counter",
+    ]
+    for e, r in counters.items():
+        lines.append(
+            f'tbtpu_events_total{{event="{_label_escape(e)}"}} {r["count"]}'
+        )
+    lines += [
+        "# HELP tbtpu_gauge Gauge registry (queue depths, table counts).",
+        "# TYPE tbtpu_gauge gauge",
+    ]
+    for name in sorted(_gauges):
+        lines.append(
+            f'tbtpu_gauge{{name="{_label_escape(name)}"}} {_gauges[name]:.9g}'
+        )
+    return "\n".join(lines) + "\n"
+
+
+async def serve_metrics(port: int, host: str = "127.0.0.1"):
+    """Serve GET /metrics (Prometheus text) and /trace (Perfetto JSON)
+    on the current asyncio loop; returns the asyncio.Server. Wired by
+    `cli.py start --metrics-port` onto the replica's own event loop —
+    a scrape shares the loop, so it observes the live registry with no
+    extra thread."""
+    import asyncio
+
+    async def _handle(reader, writer) -> None:
+        try:
+            req = await reader.readline()
+            while True:
+                line = await reader.readline()
+                if not line or line in (b"\r\n", b"\n"):
+                    break
+            parts = req.split()
+            path = parts[1].decode("latin-1") if len(parts) >= 2 else "/"
+            status = "200 OK"
+            if path.startswith("/metrics"):
+                body = prometheus_text().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path.startswith("/trace"):
+                body = json.dumps(export_trace()).encode()
+                ctype = "application/json"
+            else:
+                body = b"tigerbeetle-tpu observability: /metrics /trace\n"
+                ctype = "text/plain; charset=utf-8"
+                status = "404 Not Found" if path != "/" else "200 OK"
+            writer.write(
+                (
+                    f"HTTP/1.1 {status}\r\nContent-Type: {ctype}\r\n"
+                    f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
+                ).encode() + body
+            )
+            await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001 — scrape teardown is best-effort
+                pass
+
+    return await asyncio.start_server(_handle, host, port)
+
+
+# --- devhub series ------------------------------------------------------
+
+_git_revision_cache: Optional[str] = None
+
+
+def _git_revision() -> str:
+    """Short `git rev-parse HEAD` of this checkout (cached; 'unknown'
+    outside a repo) — stamps devhub records to a commit."""
+    global _git_revision_cache
+    if _git_revision_cache is None:
+        import subprocess
+
+        try:
+            out = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                capture_output=True, text=True, timeout=10,
+            )
+            _git_revision_cache = out.stdout.strip() or "unknown"
+        except Exception:  # noqa: BLE001 — no git, no stamp
+            _git_revision_cache = "unknown"
+    return _git_revision_cache
+
+
 def devhub_append(path: str, record: dict) -> None:
     """Append one benchmark record to the JSON-lines series
-    (devhub.zig:36-52's git-backed database, minus the git)."""
+    (devhub.zig:36-52's git-backed database, minus the git): stamped
+    with the wall clock and the current git revision so every row is
+    attributable to a commit."""
     rec = dict(record)
     rec.setdefault("unix_timestamp", int(time.time()))
+    rec.setdefault("git", _git_revision())
     with open(path, "a") as f:
         f.write(json.dumps(rec) + "\n")

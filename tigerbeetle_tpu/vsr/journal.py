@@ -59,6 +59,7 @@ class WalWriter:
     def submit(self, segments, cb: Callable[[], None]) -> None:
         with self._cond:
             self._pending.append((segments, cb))
+            tracer.gauge("pipeline.wal.depth", len(self._pending))
             self._cond.notify_all()
 
     def barrier(self, cb: Callable[[], None]) -> None:
@@ -87,37 +88,45 @@ class WalWriter:
             self._cond.notify_all()
 
     def _run(self) -> None:
+        from tigerbeetle_tpu.vsr.pipeline import _timed_wait
+
         while True:
             with self._cond:
                 while not self._pending and not self._stopped:
-                    self._cond.wait()
+                    _timed_wait(self._cond, "pipeline.wal.idle")
                 if self._stopped and not self._pending:
                     return
                 batch, self._pending = self._pending, []
                 self._busy = True
             try:
+                # wal.write spans run ON the writer thread: the WAL row in
+                # the Perfetto timeline, and the durable-write latency
+                # histogram (as opposed to stage.wal, the loop-side
+                # enqueue cost).
                 if getattr(self._storage, "supports_direct", False):
                     for segments, cb in batch:
-                        for offset, chunks, durable in segments or ():
-                            if durable:
-                                self._storage.write_durable(offset, chunks)
-                            else:
+                        with tracer.span("wal.write"):
+                            for offset, chunks, durable in segments or ():
+                                if durable:
+                                    self._storage.write_durable(offset, chunks)
+                                else:
+                                    pos = offset
+                                    for c in chunks:
+                                        self._storage.write(pos, c)
+                                        pos += len(c)
+                        self._post(cb)
+                else:
+                    with tracer.span("wal.write"):
+                        wrote = False
+                        for segments, _cb in batch:
+                            for offset, chunks, _durable in segments or ():
                                 pos = offset
                                 for c in chunks:
                                     self._storage.write(pos, c)
                                     pos += len(c)
-                        self._post(cb)
-                else:
-                    wrote = False
-                    for segments, _cb in batch:
-                        for offset, chunks, _durable in segments or ():
-                            pos = offset
-                            for c in chunks:
-                                self._storage.write(pos, c)
-                                pos += len(c)
-                            wrote = True
-                    if wrote:
-                        self._storage.sync()
+                                wrote = True
+                        if wrote:
+                            self._storage.sync()
                     for _segments, cb in batch:
                         self._post(cb)
             except Exception as e:  # noqa: BLE001 — fail-stop, never wedge

@@ -42,11 +42,26 @@ instead of wedging with a silently dead stage.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Callable, List, Optional, Tuple
 
+from tigerbeetle_tpu import tracer
+
 # Max jobs popped per cycle (keeps park/reset bookkeeping bounded).
 RUN_MAX = 8
+
+
+def _timed_wait(cond: threading.Condition, event: str) -> None:
+    """One condition wait, recorded as stage idle/stall time when tracing
+    is on (the per-stage stall/idle registry rows — the quantity that
+    decides whether a stage overlaps usefully or just time-slices)."""
+    if not tracer.enabled():
+        cond.wait()
+        return
+    t0 = time.perf_counter_ns()
+    cond.wait()
+    tracer.observe(event, time.perf_counter_ns() - t0)
 
 
 class CommitExecutor:
@@ -79,6 +94,7 @@ class CommitExecutor:
     def submit(self, job: dict) -> None:
         with self._cond:
             self._pending.append(job)
+            tracer.gauge("pipeline.commit.depth", len(self._pending))
             self._cond.notify_all()
 
     def pop_done(self) -> Optional[dict]:
@@ -158,7 +174,7 @@ class CommitExecutor:
         while True:
             with self._cond:
                 while (not self._pending or self._parked) and not self._stopped:
-                    self._cond.wait()
+                    _timed_wait(self._cond, "pipeline.commit.idle")
                 if self._stopped:
                     return
                 run = [
@@ -255,7 +271,10 @@ class StoreExecutor:
                 and not self._parked
                 and not self._stopped
             ):
-                self._cond.wait()
+                # Backpressure STALL: the commit thread is blocked on the
+                # store stage — the registry row that shows whether the
+                # store thread is the pipeline's bottleneck.
+                _timed_wait(self._cond, "pipeline.store.stall")
             if self._stopped:
                 # Shutdown race: the commit executor may settle its last
                 # in-flight run after stop() was issued. Dropping the job
@@ -264,6 +283,7 @@ class StoreExecutor:
                 # next open().
                 return
             self._pending.append(job)
+            tracer.gauge("pipeline.store.depth", len(self._pending))
             self._cond.notify_all()
 
     def drain(self) -> None:
@@ -355,7 +375,7 @@ class StoreExecutor:
         while True:
             with self._cond:
                 while (not self._pending or self._parked) and not self._stopped:
-                    self._cond.wait()
+                    _timed_wait(self._cond, "pipeline.store.idle")
                 if self._stopped:
                     return
                 job = self._pending.popleft()

@@ -1035,6 +1035,7 @@ class Replica:
                 # asyncio pushes it to the socket synchronously when the
                 # buffer is empty — the client pipelines its next request
                 # against our store/compaction work below.
+                tracer.count("vsr.replies")
                 self.bus.send_to_client(entry.message.header["client"], reply)
             try:
                 self._finish_commit()
@@ -1048,6 +1049,12 @@ class Replica:
                 break
         while self.request_queue and len(self.pipeline) < self.config.pipeline_max:
             self._primary_prepare(self.request_queue.pop(0))
+        if tracer.enabled():
+            # Pipeline-pressure gauges: prepare pipeline, client request
+            # backlog, and ops staged through the commit executor.
+            tracer.gauge("vsr.pipeline.depth", len(self.pipeline))
+            tracer.gauge("vsr.request_queue.depth", len(self.request_queue))
+            tracer.gauge("vsr.stage.depth", len(self._staged))
 
     def _send_commit_heartbeat(self) -> None:
         self.last_commit_sent_tick = self.tick_count
@@ -1385,6 +1392,7 @@ class Replica:
         if self.aof is not None:
             self.aof.append(msg, self.primary_index(h["view"]), self.replica)
         sm = self.state_machine
+        tracer.count("vsr.commits")
         with tracer.span("replica.execute"):
             results = sm.create_transfers_finish(job.pop("_handle")).tobytes()
             sm.prepare_timestamp = max(sm.prepare_timestamp, int(h["timestamp"]))
@@ -1452,6 +1460,7 @@ class Replica:
         if job.get("entry") is not None and reply is not None:
             # Reply as soon as the completion lands — asyncio pushes it to
             # the socket while the executor already works on later ops.
+            tracer.count("vsr.replies")
             self.bus.send_to_client(spec["client"], reply)
         if fault is not None:
             # Finish-phase fault: committed, but the op's deferred
@@ -2523,6 +2532,7 @@ class Replica:
             self.aof.append(
                 prepare, self.primary_index(prepare.header["view"]), self.replica
             )
+        tracer.count("vsr.commits")
         with tracer.span("replica.execute"):
             results = self._execute_op(prepare)
             out = self._execute_tail(

@@ -1,0 +1,131 @@
+"""Trace summary: per-thread / per-stage table from a saved Chrome
+trace-event JSON file (tracer.export_trace / tracer.dump / the live
+`/trace` endpoint).
+
+Prints, per thread: busy time (union of its span intervals), idle time,
+and the per-event stats (count, total, p50/p99 exact from the raw
+durations — the offline tool can afford exact percentiles); then the
+cross-thread overlap histogram (how much wall time had 0/1/2/.. threads
+busy) — the one-glance answer to "does the pipeline actually overlap,
+and which stage stalls it".
+
+Usage:
+    python tools/trace_summary.py /tmp/tbtpu_trace.json
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+
+def _union(intervals: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
+    """Merge overlapping/nested intervals (spans nest within a thread)."""
+    if not intervals:
+        return []
+    intervals.sort()
+    out = [list(intervals[0])]
+    for lo, hi in intervals[1:]:
+        if lo <= out[-1][1]:
+            if hi > out[-1][1]:
+                out[-1][1] = hi
+        else:
+            out.append([lo, hi])
+    return [(lo, hi) for lo, hi in out]
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(len(sorted_vals) - 1, int(len(sorted_vals) * q))]
+
+
+def summarize(path: str) -> str:
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents", doc if isinstance(doc, list) else [])
+    names: Dict[int, str] = {}
+    spans: Dict[int, List[dict]] = defaultdict(list)
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            names[e["tid"]] = e.get("args", {}).get("name", str(e["tid"]))
+        elif e.get("ph") == "X":
+            spans[e["tid"]].append(e)
+    if not spans:
+        return "no complete ('ph': 'X') events in the trace"
+
+    t_min = min(e["ts"] for evs in spans.values() for e in evs)
+    t_max = max(e["ts"] + e.get("dur", 0.0) for evs in spans.values() for e in evs)
+    wall_ms = (t_max - t_min) / 1e3
+
+    lines = [f"trace: {path}", f"wall: {wall_ms:.1f} ms, threads: {len(spans)}"]
+    busy_by_tid: Dict[int, List[Tuple[float, float]]] = {}
+    for tid, evs in sorted(spans.items(), key=lambda kv: kv[1][0]["ts"]):
+        tname = names.get(tid, str(tid))
+        # Idle/stall spans measure waiting, not work, and server.total is
+        # the window marker: keep them out of the busy union but report
+        # them as their own rows.
+        work = [e for e in evs
+                if not e["name"].endswith((".idle", ".stall"))
+                and e["name"] != "server.total"]
+        busy = _union([(e["ts"], e["ts"] + e.get("dur", 0.0)) for e in work])
+        busy_by_tid[tid] = busy
+        busy_ms = sum(hi - lo for lo, hi in busy) / 1e3
+        lines.append(
+            f"\n{tname} (tid {tid}): busy {busy_ms:.1f} ms "
+            f"({100 * busy_ms / wall_ms:.1f}% of wall), "
+            f"{len(evs)} spans"
+        )
+        lines.append(
+            f"  {'event':26s} {'count':>7s} {'total_ms':>10s} "
+            f"{'p50_us':>9s} {'p99_us':>9s} {'max_us':>9s}"
+        )
+        by_event: Dict[str, List[float]] = defaultdict(list)
+        for e in evs:
+            by_event[e["name"]].append(e.get("dur", 0.0))
+        for name in sorted(
+            by_event, key=lambda n: -sum(by_event[n])
+        ):
+            durs = sorted(by_event[name])
+            lines.append(
+                f"  {name:26s} {len(durs):7d} {sum(durs) / 1e3:10.1f} "
+                f"{_pct(durs, 0.5):9.1f} {_pct(durs, 0.99):9.1f} "
+                f"{durs[-1]:9.1f}"
+            )
+
+    # Overlap histogram: sweep the busy-union edges across threads.
+    edges = []
+    for busy in busy_by_tid.values():
+        for lo, hi in busy:
+            edges.append((lo, 1))
+            edges.append((hi, -1))
+    edges.sort()
+    overlap_us: Dict[int, float] = defaultdict(float)
+    depth = 0
+    prev = t_min
+    for t, d in edges:
+        if t > prev:
+            overlap_us[depth] += t - prev
+        prev = t
+        depth += d
+    overlap_us[depth] += max(0.0, t_max - prev)
+    lines.append("\nthread overlap (share of wall with N threads busy):")
+    for n in sorted(overlap_us):
+        ms = overlap_us[n] / 1e3
+        lines.append(f"  {n} busy: {ms:10.1f} ms  {100 * ms / wall_ms:5.1f}%")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print(__doc__, file=sys.stderr)
+        return 2
+    print(summarize(argv[0]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
