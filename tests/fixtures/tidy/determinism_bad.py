@@ -1,0 +1,36 @@
+"""Fixture: seeded determinism violations for tests/test_tidy.py.
+
+One method per banned rule code, plus one ALLOWED use proving the
+inline suppression works. The expected-findings assertion is exact.
+"""
+
+import os
+import random
+import time
+
+
+class BadStateMachine:
+    def __init__(self):
+        self.balance = 0
+        self.drift = 0.0
+
+    def stamp(self):
+        return time.time()
+
+    def stamp_sanctioned(self):
+        return time.time()  # tidy: allow=wall-clock — fixture: suppression must work
+
+    def salt(self):
+        return random.random()
+
+    def config(self):
+        return os.environ.get("UNSAFE_KNOB")
+
+    def key_of(self, obj):
+        return id(obj)
+
+    def fold(self):
+        return [x for x in {3, 1, 2}]
+
+    def accumulate(self, x):
+        self.drift += x * 0.1

@@ -1,0 +1,51 @@
+"""Fixture: seeded thread-ownership violations for tests/test_tidy.py.
+
+One class shaped like a pipeline stage, carrying exactly three
+violations the ownership pass must find:
+
+  1. `peek` reads the `guarded-by=_cond` queue outside the lock
+     (unlocked-access);
+  2. `_run` (resolved to the "store" role through its Thread name)
+     writes the `owner=loop` reply slot (wrong-thread);
+  3. `_counter` is written from both the loop and store roles with no
+     lock and no declaration (undeclared-shared).
+
+Everything else is deliberately clean so the expected-findings
+assertion is exact.
+"""
+
+import threading
+from collections import deque
+
+
+class BadStage:
+    def __init__(self, post):
+        self._post = post
+        self._cond = threading.Condition()
+        self._queue = deque()  # tidy: guarded-by=_cond
+        self._reply = None  # tidy: owner=loop
+        self._counter = 0
+        self._thread = threading.Thread(
+            target=self._run, name="store-executor", daemon=True
+        )
+
+    def submit(self, job):
+        with self._cond:
+            self._queue.append(job)
+            self._cond.notify_all()
+        self._counter += 1
+
+    def peek(self):
+        return len(self._queue)
+
+    def reply(self):
+        return self._reply
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while not self._queue:
+                    self._cond.wait()
+                job = self._queue.popleft()
+            self._reply = job
+            self._counter += 1

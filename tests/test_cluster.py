@@ -454,8 +454,13 @@ class TestOverlappedPipeline:
 
     def _drive(self, overlap: bool, hash_log=None, store_async: bool = False):
         from tigerbeetle_tpu.testing.hash_log import attach_to_cluster
+        from tigerbeetle_tpu.tidy import runtime as tidy_runtime
         from tigerbeetle_tpu.vsr.clock import Clock, DeterministicTime
 
+        # Full-pipeline determinism runs double as the runtime
+        # thread-affinity and lock-order audit (tidy/runtime.py): enable
+        # BEFORE construction so the stage conditions are order-tracked.
+        tidy_runtime.enable()
         cl = Cluster(
             replica_count=3, seed=9, overlap=overlap, store_async=store_async
         )
@@ -476,7 +481,14 @@ class TestOverlappedPipeline:
                          credit_account_id=2, amount=1 + k, ledger=1, code=1)
                     for k in range(4)
                 ]))
-            target = cl.replicas[0].commit_min
+            # Wait for CATCH-UP, not just the driver's view of done: every
+            # backup must reach the highest commit anywhere before capture,
+            # so the chain comparison below can demand complete coverage
+            # instead of tolerating 1-2 lagging tail ops (the pre-round-9
+            # flake under full-suite load).
+            target = max(
+                r.commit_min for r in cl.replicas if r is not None
+            )
             cl.run_until(lambda: all(
                 r.commit_min >= target for r in cl.replicas if r is not None
             ), 60_000)
@@ -507,16 +519,17 @@ class TestOverlappedPipeline:
             return chains, floors, dict(cl._checkpoint_history)
         finally:
             cl.close()
+            tidy_runtime.disable()
 
     def _check_runs_identical(self, serial, *others):
         """Cross-run determinism: every commit checksum recorded by any
         replica of any run must agree op-for-op, and every checkpoint's
-        trailer section digests must match across runs. Chain COVERAGE is
-        allowed to be ragged — a backup can stand one or two ops behind
-        at capture time, and a scheduler-starved replica may even have
-        block/state-synced past old ops (suffix chain, checksum_floor >
-        0) — but at least one replica per run must carry the complete
-        unbroken chain of the whole workload."""
+        trailer section digests must match across runs. Coverage is
+        STRICT: _drive waits for full catch-up before capture, so every
+        replica must carry the contiguous chain from its checksum floor
+        (0 unless it block/state-synced past old ops) to the workload's
+        final op — lagging tails are a bug in the wait, not tolerated
+        noise (the pre-round-9 flake)."""
         want = self.OPS + 2  # register + create_accounts + the transfers
         runs = (serial, *others)
         ref: dict = {}
@@ -526,11 +539,21 @@ class TestOverlappedPipeline:
                     assert ref.setdefault(op, v) == v, (
                         f"divergent commit checksum at op {op}"
                     )
-        for chains, floors, _hist in runs:
-            assert any(
-                f == 0 and len(c) == max(c) >= want
-                for c, f in zip(chains, floors)
-            ), "no replica carried the complete chain"
+        for run_ix, (chains, floors, _hist) in enumerate(runs):
+            for c, f in zip(chains, floors):
+                assert c and max(c) >= want, (
+                    f"run {run_ix}: replica tail lags — chain reaches "
+                    f"{max(c) if c else 0}, workload committed {want}"
+                )
+                missing = set(range(f + 1, max(c) + 1)) - set(c)
+                assert not missing, (
+                    f"run {run_ix}: chain has holes above floor {f}: "
+                    f"{sorted(missing)[:8]}"
+                )
+            assert any(f == 0 for f in floors), (
+                f"run {run_ix}: no replica carried the complete chain "
+                f"from op 1"
+            )
         s_hist = serial[2]
         for _chains, _floors, hist in others:
             common = set(s_hist) & set(hist)

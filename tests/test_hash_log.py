@@ -19,6 +19,14 @@ def _drive(cluster, n=8):
             dict(id=1 + i, debit_account_id=1, credit_account_id=2,
                  amount=1 + i, ledger=1, code=1),
         ]))
+    # Wait for catch-up before the caller closes the log: every replica
+    # (the logging replica 0 included) must commit the full workload, so
+    # a create-mode run records the complete chain and a check-mode run
+    # replays ALL of it — never a tail short 1-2 ops under suite load.
+    target = max(r.commit_min for r in cluster.replicas if r is not None)
+    cluster.run_until(lambda: all(
+        r.commit_min >= target for r in cluster.replicas if r is not None
+    ), 60_000)
 
 
 def test_create_then_check_same_seed(tmp_path):

@@ -38,6 +38,12 @@ def test_overlap_stage_gates_on_grid_repair_and_checkpoint():
     )
     from tigerbeetle_tpu.vsr.header import Operation
 
+    from tigerbeetle_tpu.tidy import runtime as tidy_runtime
+
+    # The park/reclaim/repair/resume schedule is the nastiest cross-thread
+    # interleaving in the pipeline — run it under the tidy runtime's
+    # thread-affinity and lock-order assertions (no-op in production).
+    tidy_runtime.enable()
     cl = Cluster(replica_count=3, seed=77, overlap=True)
     try:
         # Record every replica's execution order (the commit event fires
@@ -128,3 +134,4 @@ def test_overlap_stage_gates_on_grid_repair_and_checkpoint():
         assert cl.check_storage_convergence() >= 16
     finally:
         cl.close()
+        tidy_runtime.disable()

@@ -1,53 +1,368 @@
-"""Source hygiene (the reference's tidy.zig test family): bans stub
-markers and debug leftovers from the package, and checks every module
-documents itself. Also the id-permutation utility's bijectivity
-(reference testing/id.zig)."""
+"""The tidy analyzer (tigerbeetle_tpu/tidy/): source hygiene, the
+thread-ownership/lockset pass, the determinism lint, the runtime
+affinity/lock-order assertions, and the tools/tidy_check.py gate.
 
-import ast
+This file is ALSO the tier-1 CI entry for the analyzer: the
+zero-new-findings test runs the same check() the CLI runs, so a
+cross-thread access or determinism leak introduced anywhere in the
+package fails the suite, not just a manual tool run.
+
+Plus the id-permutation utility's bijectivity (reference testing/id.zig),
+kept from the original tidy test family.
+"""
+
+import json
 import pathlib
+import subprocess
+import sys
+import threading
 
 import pytest
 
-PKG = pathlib.Path(__file__).resolve().parent.parent / "tigerbeetle_tpu"
-
-BANNED = (
-    "NotImplementedError",
-    "TODO",
-    "FIXME",
-    "XXX",
-    "breakpoint(",
-    "import pdb",
-)
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "fixtures" / "tidy"
 
 
-def _sources():
-    return sorted(PKG.rglob("*.py"))
+def _tidy_check():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "tidy_check", REPO / "tools" / "tidy_check.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
-def test_no_stub_markers_or_debug_leftovers():
-    offenders = []
-    for path in _sources():
-        text = path.read_text()
-        for banned in BANNED:
-            if banned in text:
-                for i, line in enumerate(text.splitlines(), 1):
-                    if banned in line:
-                        offenders.append(f"{path.name}:{i}: {banned}")
-    assert not offenders, offenders
+# --- the repo itself is clean (the CI gate) -----------------------------
 
 
-def test_every_module_has_a_docstring():
-    missing = []
-    for path in _sources():
-        tree = ast.parse(path.read_text())
-        if ast.get_docstring(tree) is None and path.name != "__init__.py":
-            missing.append(str(path))
-    assert not missing, missing
+def test_repo_has_no_new_findings():
+    """Every pass over the real package: zero findings beyond the
+    checked-in baseline, and no rotted baseline entries either."""
+    report = _tidy_check().check()
+    assert report["ok"], "\n".join(
+        f"{f['file']}:{f['line']}: [{f['pass']}/{f['code']}] {f['message']}"
+        for f in report["new"]
+    )
+    assert not report["stale_baseline_keys"], report["stale_baseline_keys"]
+
+
+def test_cli_json_mode():
+    """`tools/tidy_check.py --json` (the bench_gate-style automation
+    surface): exit 0 on the clean repo, parseable JSON with the full
+    finding/baseline split."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "tidy_check.py"), "--json"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["ok"] is True
+    assert set(report["passes"]) == {"ownership", "determinism", "markers"}
+    assert isinstance(report["findings"], list)
+
+
+# --- ownership pass: fixture with known violations ----------------------
+
+
+def test_ownership_fixture_exact_findings():
+    from tigerbeetle_tpu.tidy import ownership
+
+    findings = ownership.analyze_file(FIXTURES / "ownership_bad.py", REPO)
+    got = sorted((f.code, f.scope, f.subject) for f in findings)
+    assert got == [
+        ("undeclared-shared", "BadStage", "_counter"),
+        ("unlocked-access", "BadStage.peek", "_queue"),
+        ("wrong-thread", "BadStage._run", "_reply"),
+    ], findings
+    by_code = {f.code: f for f in findings}
+    # The wrong-thread write resolves the worker's role from its Thread
+    # name and reports both sides of the mismatch.
+    assert "owner=loop" in by_code["wrong-thread"].message
+    assert "store" in by_code["wrong-thread"].message
+    # The Eraser-style finding names every access site.
+    assert "submit/write" in by_code["undeclared-shared"].message
+    assert "_run/write" in by_code["undeclared-shared"].message
+
+
+def test_ownership_unknown_annotation_key_is_a_finding(tmp_path):
+    from tigerbeetle_tpu.tidy import ownership
+
+    bad = tmp_path / "m.py"
+    bad.write_text(
+        '"""Doc."""\n\n\nclass C:\n    def __init__(self):\n'
+        "        self.x = 1  # tidy: onwer=loop\n"
+    )
+    findings = ownership.analyze_file(bad, tmp_path)
+    assert [f.code for f in findings] == ["unknown-annotation"]
+    assert findings[0].subject == "onwer"
+
+
+def test_ownership_guarded_attr_clean_when_locked(tmp_path):
+    """The inverse fixture: the same shape with the lock held and the
+    declarations honored produces ZERO findings."""
+    from tigerbeetle_tpu.tidy import ownership
+
+    good = tmp_path / "good.py"
+    good.write_text(
+        '"""Doc."""\n'
+        "import threading\n"
+        "from collections import deque\n\n\n"
+        "class GoodStage:\n"
+        "    def __init__(self):\n"
+        "        self._cond = threading.Condition()\n"
+        "        self._queue = deque()  # tidy: guarded-by=_cond\n\n"
+        "    def submit(self, job):\n"
+        "        with self._cond:\n"
+        "            self._queue.append(job)\n\n"
+        "    def _run(self):  # tidy: thread=store\n"
+        "        with self._cond:\n"
+        "            return self._queue.popleft()\n"
+    )
+    assert ownership.analyze_file(good, tmp_path) == []
+
+
+def test_ownership_guarded_by_multi_lock_means_any_of(tmp_path):
+    """`guarded-by=a|b` accepts an access holding EITHER declared lock
+    and reports the full set — never an arbitrary frozenset pick (which
+    would make findings and baseline keys hash-seed-dependent)."""
+    from tigerbeetle_tpu.tidy import ownership
+
+    f = tmp_path / "m.py"
+    f.write_text(
+        '"""Doc."""\n'
+        "import threading\n\n\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "        self._x = 0  # tidy: guarded-by=_a|_b\n\n"
+        "    def f(self):\n"
+        "        with self._a:\n"
+        "            self._x += 1\n\n"
+        "    def g(self):\n"
+        "        with self._b:\n"
+        "            self._x += 1\n\n"
+        "    def h(self):\n"
+        "        self._x += 1\n"
+    )
+    findings = ownership.analyze_file(f, tmp_path)
+    assert [(x.code, x.scope) for x in findings] == [("unlocked-access", "C.h")]
+    assert "_a|_b" in findings[0].message
+
+
+# --- determinism pass ----------------------------------------------------
+
+
+def test_determinism_fixture_exact_findings():
+    from tigerbeetle_tpu.tidy import determinism
+
+    findings = determinism.analyze_file(FIXTURES / "determinism_bad.py", REPO)
+    got = sorted((f.code, f.scope) for f in findings)
+    assert got == [
+        ("env-read", "BadStateMachine.config"),
+        ("float-acc", "BadStateMachine.accumulate"),
+        ("id-key", "BadStateMachine.key_of"),
+        ("random", "BadStateMachine.salt"),
+        ("set-iter", "BadStateMachine.fold"),
+        ("wall-clock", "BadStateMachine.stamp"),
+    ], findings
+    # stamp_sanctioned's identical call is allow=-suppressed: exactly one
+    # wall-clock finding, proving the inline escape works.
+    assert sum(1 for f in findings if f.code == "wall-clock") == 1
+
+
+def test_determinism_scope_excludes_clock():
+    """vsr/clock.py is the ONE sanctioned wall-clock reader — the scope
+    must exclude it while covering the rest of vsr/."""
+    from tigerbeetle_tpu.tidy import determinism
+
+    findings = determinism.run(REPO)
+    assert not any(f.file.endswith("vsr/clock.py") for f in findings)
+    # And the scoped run over the real core is clean (annotated escapes
+    # like the tracer's perf_counter in _timed_wait carry reasons).
+    assert findings == [], [f.render() for f in findings]
+
+
+# --- markers pass (extended scope) ---------------------------------------
+
+
+def test_marker_scan_covers_tools_tests_and_scripts():
+    from tigerbeetle_tpu.tidy import markers
+
+    files = {p.resolve() for p in markers._scan_files(REPO)}
+    assert (REPO / "tools" / "tidy_check.py").resolve() in files
+    assert (REPO / "tests" / "test_tidy.py").resolve() in files
+    assert (REPO / "bench.py").resolve() in files
+    assert (REPO / "profile_e2e.py").resolve() in files
+    # Fixture modules deliberately violate rules: excluded wholesale.
+    assert (FIXTURES / "ownership_bad.py").resolve() not in files
+
+
+def test_marker_scan_flags_and_allows(tmp_path):
+    from tigerbeetle_tpu.tidy import manifest, markers
+
+    banned = manifest.BANNED_MARKERS[0]  # the stub-exception marker
+    f = tmp_path / "script.py"
+    f.write_text(
+        f'"""Doc."""\nraise {banned}\n'
+        f'x = "{banned}"  # tidy: allow=marker — testing the allowlist\n'
+    )
+    findings = markers.scan_file(f, tmp_path)
+    assert [(x.code, x.line) for x in findings] == [("banned-marker", 2)]
+
+
+def test_repo_markers_clean():
+    from tigerbeetle_tpu.tidy import markers
+
+    findings = markers.run(REPO)
+    assert findings == [], [f.render() for f in findings]
+
+
+# --- baseline workflow ---------------------------------------------------
+
+
+def test_baseline_roundtrip_and_staleness(tmp_path):
+    from tigerbeetle_tpu.tidy.findings import (
+        Finding, load_baseline, split_by_baseline, write_baseline,
+    )
+
+    f1 = Finding("ownership", "wrong-thread", "a.py", 10, "C.m", "_x", "msg")
+    f2 = Finding("determinism", "wall-clock", "b.py", 3, "f", "time.time", "msg")
+    path = tmp_path / "baseline.json"
+    write_baseline([f1, f2], path)
+    baseline = load_baseline(path)
+    assert set(baseline) == {f1.key(), f2.key()}
+    # Line numbers are NOT part of the key: the entry survives edits.
+    f1_moved = Finding("ownership", "wrong-thread", "a.py", 99, "C.m", "_x", "msg")
+    new, suppressed, stale = split_by_baseline([f1_moved], baseline)
+    assert new == [] and len(suppressed) == 1
+    assert stale == [f2.key()]  # f2 no longer produced → reported, not silent
+
+
+# --- runtime assertions (tidy/runtime.py) --------------------------------
+
+
+class TestTidyRuntime:
+    def _fresh(self):
+        from tigerbeetle_tpu.tidy import runtime
+
+        runtime.disable()
+        runtime.reset_order_graph()
+        return runtime
+
+    def test_disabled_is_null_object(self):
+        """Disabled = production: plain threading primitives (zero added
+        cost on every `with lock:`), and the assertion entry points are
+        flag-check no-ops."""
+        rt = self._fresh()
+        assert type(rt.make_condition("x")) is threading.Condition
+        assert type(rt.make_lock("x")) is type(threading.Lock())
+        rt.stamp("store")
+        rt.assert_role("loop")  # wrong role, but disabled: no raise
+        assert rt.current_role() is None
+
+    def test_wrong_thread_asserts(self):
+        rt = self._fresh()
+        rt.enable()
+        try:
+            errors = []
+
+            def worker():
+                rt.stamp("store")
+                try:
+                    rt.assert_role("commit", "loop")
+                except AssertionError as e:
+                    errors.append(e)
+
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+            assert len(errors) == 1 and "store" in str(errors[0])
+            # Unstamped threads (arbitrary test callers) are exempt.
+            rt.assert_role("loop")
+        finally:
+            rt.disable()
+
+    def test_lock_order_inversion_asserts(self):
+        rt = self._fresh()
+        rt.enable()
+        try:
+            a, b = rt.make_lock("lock.a"), rt.make_lock("lock.b")
+            with a:
+                with b:
+                    pass
+            with pytest.raises(AssertionError, match="lock-order inversion"):
+                with b:
+                    with a:
+                        pass
+        finally:
+            rt.disable()
+            rt.reset_order_graph()
+
+    def test_condition_reentrancy_and_order(self):
+        rt = self._fresh()
+        rt.enable()
+        try:
+            c = rt.make_condition("cond.x")
+            lk = rt.make_lock("lock.y")
+            with c:
+                with c:  # re-entrant RLock: no self-edge, no raise
+                    pass
+                with lk:
+                    pass
+            # Same nesting again: consistent order, still fine.
+            with c:
+                with lk:
+                    pass
+        finally:
+            rt.disable()
+            rt.reset_order_graph()
+
+    def test_pipeline_stages_stamp_roles(self):
+        """A real CommitExecutor/StoreExecutor pair under the enabled
+        runtime: wrong-context calls to the stage entry points raise."""
+        rt = self._fresh()
+        rt.enable()
+        try:
+            from tigerbeetle_tpu.vsr.pipeline import StoreExecutor
+
+            roles = []
+            done = threading.Event()
+
+            def process(job):
+                roles.append(rt.current_role())
+                done.set()
+                return None
+
+            se = StoreExecutor(process, post=lambda cb: cb())
+            try:
+                rt.stamp("loop")
+                se.submit({"op": 1, "store": None})
+                assert done.wait(5)
+                se.drain()
+                assert roles == ["store"]  # worker stamped itself
+
+                # The store thread must never submit (producer entry is
+                # commit|loop): simulate by stamping this thread wrongly.
+                rt.stamp("store")
+                with pytest.raises(AssertionError, match="owned by"):
+                    se.submit({"op": 2, "store": None})
+            finally:
+                rt.stamp("loop")
+                se.stop()
+        finally:
+            rt.disable()
+            rt.reset_order_graph()
+
+
+# --- id permutations (reference testing/id.zig), kept from the original --
 
 
 @pytest.mark.parametrize("seed", [0, 1, 7])
 def test_id_permutations_bijective(seed):
-    import random
+    import random  # tidy: allow=random — seeded test-local RNG
 
     from tigerbeetle_tpu.testing import id as id_mod
 

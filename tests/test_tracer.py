@@ -69,15 +69,29 @@ def test_disabled_path_is_allocation_free():
     assert tracer.snapshot() == {}
 
 
+def test_gauge_retirement(traced):
+    """Per-instance gauges (a connection's send queue) are removed when
+    their identity dies — the registry and scrape body must not grow
+    forever under client churn."""
+    tracer.gauge("bus.send_queue_bytes.10.0.0.1:54321", 128)
+    assert "bus.send_queue_bytes.10.0.0.1:54321" in tracer.gauges()
+    tracer.remove_gauge("bus.send_queue_bytes.10.0.0.1:54321")
+    assert "bus.send_queue_bytes.10.0.0.1:54321" not in tracer.gauges()
+    tracer.remove_gauge("never.existed")  # idempotent
+
+
 def test_histogram_bucket_roundtrip():
     """bucket_value(bucket_index(v)) within one sub-bucket (12.5%) of v,
     and bucket_index is monotone."""
     prev = -1
     for exp in range(0, 50):
+        # v is non-decreasing across iterations (2^e, 1.5*2^e, 2^(e+1), …)
+        # so bucket_index must be too.
         for v in (1 << exp, (1 << exp) + (1 << max(0, exp - 1))):
             idx = tracer.bucket_index(v)
             assert 0 <= idx < tracer.HIST_BUCKETS
-            assert idx >= prev or v < 1 << exp
+            assert idx >= prev, (v, idx, prev)
+            prev = idx
             rep = tracer.bucket_value(idx)
             assert abs(rep - v) <= max(1, v / (1 << tracer.HIST_SUB_BITS)), (
                 v, idx, rep,

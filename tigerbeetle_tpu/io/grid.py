@@ -180,16 +180,18 @@ class Grid:
         # superseding checkpoint is durable; standalone users free eagerly.
         self.defer_releases = defer_releases
         self.free_set = FreeSet(block_count)
+        # tidy: atomic — lock-free by design: each OrderedDict op is GIL-atomic; composed sequences tolerate interleaving via KeyError guards (acceleration, never source of truth)
         self._cache: OrderedDict[int, bytes] = OrderedDict()
         self._cache_blocks = cache_blocks
         # RAM map of each written block's payload checksum — the identity
         # side of block-level state sync (a checkpoint publishes
         # (index, checksum) pairs; peers fetch only blocks whose local
         # checksum differs). Restored from the checkpoint blob at open.
+        # tidy: atomic — GIL-atomic single-key dict ops; a write-once block's entry is published before any reader learns its index
         self.block_cks: dict[int, int] = {}
-        self.reads = 0
-        self.writes = 0
-        self.cache_hits = 0
+        self.reads = 0  # tidy: atomic — stats counter, lost updates benign
+        self.writes = 0  # tidy: atomic — stats counter, lost updates benign
+        self.cache_hits = 0  # tidy: atomic — stats counter, lost updates benign
 
     @property
     def payload_max(self) -> int:

@@ -25,13 +25,13 @@ always rewind to the last durable manifest.
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
 import numpy as np
 
 from tigerbeetle_tpu import tracer
+from tigerbeetle_tpu.tidy import runtime as tidy_runtime
 from tigerbeetle_tpu.io.grid import Grid, GridReadFault
 from tigerbeetle_tpu.lsm.store import (
     KEY_DTYPE,
@@ -206,23 +206,31 @@ class DurableIndex:
         self.memtable_max = memtable_max
         self.growth = growth
         self.backend = backend
-        self._mem: List[Tuple[np.ndarray, np.ndarray]] = []
-        self._mem_sorted: List[bool] = []  # per-batch lo-major-sorted flag
-        self._mem_count = 0
+        # Memtable batches: appended in the store context, read drain-free
+        # from the commit thread under the flag-before-batch publish order
+        # (_sort_mem_lazily) — never concurrently mutated from both.
+        self._mem: List[Tuple[np.ndarray, np.ndarray]] = []  # tidy: owner=commit|store
+        # tidy: owner=commit|store — per-batch lo-major-sorted flag, published BEFORE its batch
+        self._mem_sorted: List[bool] = []
+        self._mem_count = 0  # tidy: owner=commit|store
         # levels[0] is newest-flush tables (append order = age order).
-        self.levels: List[List[TableInfo]] = [[]]
-        self.count = 0
-        self._job: Optional["_CompactionJob"] = None
+        # Flush/compaction publish-then-retire so drain-free readers never
+        # miss entries; structural changes stay in the store context.
+        self.levels: List[List[TableInfo]] = [[]]  # tidy: owner=commit|store
+        self.count = 0  # tidy: owner=commit|store
+        # Compaction driver state: only ever touched between beats (store
+        # context) or behind a full store barrier (checkpoint/restore).
+        self._job: Optional["_CompactionJob"] = None  # tidy: owner=commit|store
         # (level, captured input tables, reservation) of a fault-aborted
         # job, recreated verbatim on retry.
-        self._aborted_resv: Optional[tuple] = None
+        self._aborted_resv: Optional[tuple] = None  # tidy: owner=commit|store
         # Whole-table decoded-mirror LRU (see _decode_table). The lock
         # covers ONLY the LRU bookkeeping (list + row counter): the
         # commit thread's drain-free dup-confirm touches mirrors while
         # the store thread's compaction retire releases tables.
-        self._decoded_lru: List[TableInfo] = []
-        self._decoded_rows = 0
-        self._lru_lock = threading.Lock()
+        self._decoded_lru: List[TableInfo] = []  # tidy: guarded-by=_lru_lock
+        self._decoded_rows = 0  # tidy: guarded-by=_lru_lock
+        self._lru_lock = tidy_runtime.make_lock("lsm.lru")
 
     # --- geometry -------------------------------------------------------
 
@@ -486,9 +494,9 @@ class DurableIndex:
         if job.level + 1 >= len(self.levels):
             self.levels.append([])
         self.levels[job.level + 1].extend(out)
-        captured = set(id(t) for t in job.tables)
+        captured = set(id(t) for t in job.tables)  # tidy: allow=id-key — identity membership within one process, never ordered or serialized
         self.levels[job.level] = [
-            t for t in self.levels[job.level] if id(t) not in captured
+            t for t in self.levels[job.level] if id(t) not in captured  # tidy: allow=id-key — identity membership within one process, never ordered or serialized
         ]
         for t in job.tables:
             self._release_table(t)
@@ -939,7 +947,7 @@ class DurableIndex:
         )
         self._job.pending_ff = progress
 
-    def restore(self, manifest: np.ndarray) -> None:
+    def restore(self, manifest: np.ndarray) -> None:  # tidy: allow=unlocked-access — open/state-sync path: stages are reset/quiesced, no concurrent reader exists
         self._mem = []
         self._mem_sorted = []
         self._mem_count = 0

@@ -26,6 +26,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from tigerbeetle_tpu import tracer, types
+from tigerbeetle_tpu.tidy import runtime as tidy_runtime
 from tigerbeetle_tpu.constants import Config, PRODUCTION
 from tigerbeetle_tpu.flags import AccountFlags, TransferFlags
 from tigerbeetle_tpu.lsm.store import (
@@ -237,6 +238,7 @@ class StateMachine:
         self.transfer_log = DurableLog(self.grid, types.TRANSFER_DTYPE)
         # Transfer-id membership pre-filter (no false negatives): keeps the
         # per-batch duplicate-id check O(batch) instead of O(tables).
+        # tidy: owner=commit|store — adds are commit-side only (the store job passes add_bloom=False); probes are commit-side
         self.transfer_seen = Bloom(config.transfers_max)
         # Durable grooves (reference PostedGroove + account_history groove,
         # state_machine.zig:167-303): bounded RAM, LSM-backed.
@@ -261,15 +263,16 @@ class StateMachine:
         # guards, and the replica's _finish_commit applies it in strict
         # op order for determinism — inline, or as a StoreExecutor job
         # when the async store stage is attached).
-        self._deferred_store = None
+        self._deferred_store = None  # tidy: owner=commit
         # Optional async store stage (vsr/pipeline.StoreExecutor, attached
         # by the replica): queued jobs hold this state machine's pending
         # groove/index writes + beats; store_barrier drains it before any
         # store read (read-your-writes).
+        # tidy: owner=commit|loop — written at attach/state-sync reinstall (stage quiescent), read on the commit path
         self._store_stage = None
         # Resume point within compact_beat's stage list after a
         # GridReadFault was repaired (see compact_beat).
-        self._beat_stage = 0
+        self._beat_stage = 0  # tidy: owner=commit|store — advanced only inside the per-op beat, which runs in exactly one context per op
 
         # Split-phase device dispatch (the overlapped commit pipeline,
         # vsr/pipeline.py): FIFO of outstanding handles whose kernels are
@@ -285,12 +288,12 @@ class StateMachine:
             "serial_batches": 0, "bail_batches": 0,
         }
 
-    def attach_store_stage(self, stage) -> None:
+    def attach_store_stage(self, stage) -> None:  # tidy: thread=loop
         """Wire the async store stage (replica.attach_store_executor /
         state-sync reinstall). Reads then synchronize via store_barrier."""
         self._store_stage = stage
 
-    def store_barrier(self) -> None:
+    def store_barrier(self) -> None:  # tidy: thread=commit
         """Read-your-writes guard: every queued async store job and the
         current op's deferred store are applied before a store read. A
         stage parked on a corrupt block re-raises its GridReadFault here
@@ -314,7 +317,8 @@ class StateMachine:
                         break
         self.flush_deferred()
 
-    def flush_deferred(self) -> None:
+    def flush_deferred(self) -> None:  # tidy: thread=commit
+        tidy_runtime.assert_role("commit", "loop")
         d = self._deferred_store
         if d is not None:
             self._deferred_store = None
@@ -323,24 +327,26 @@ class StateMachine:
                 # Bloom membership was already published at defer time.
                 self._store_new_transfers(recs, ts=ts, add_bloom=False)
 
-    def _defer_store(self, recs: np.ndarray, ts=None) -> None:
+    def _defer_store(self, recs: np.ndarray, ts=None) -> None:  # tidy: thread=commit
         """Schedule the batch's store work for _finish_commit (inline or
         the async stage). Bloom membership is published NOW, on the
         commit thread, so the next batch's duplicate-id pre-filter is
         accurate without a store barrier — the only store state the hot
         path consults ahead of the queued writes."""
+        tidy_runtime.assert_role("commit", "loop")
         self.transfer_seen.add(recs["id_lo"], recs["id_hi"])
         self._deferred_store = (recs, ts)
 
-    def take_deferred_store(self):
+    def take_deferred_store(self):  # tidy: thread=commit
         """Pop the deferred batch for an async store job (replica
         _finish_commit). None when the op stored inline (exact/serial
         paths) or wrote nothing."""
+        tidy_runtime.assert_role("commit", "loop")
         d = self._deferred_store
         self._deferred_store = None
         return d
 
-    def _confirm_maybe_ids(self, flagged_keys: np.ndarray) -> bool:
+    def _confirm_maybe_ids(self, flagged_keys: np.ndarray) -> bool:  # tidy: thread=commit
         """Duplicate confirm for bloom maybe-hits WITHOUT draining the
         async store stage: the PENDING WRITE BUFFER (queued + in-flight
         store jobs) is consulted first, then the durable id index — which
@@ -359,6 +365,7 @@ class StateMachine:
                     return True
         return self.transfer_index.contains_any(flagged_keys)
 
+    # tidy: thread=commit|store
     def _store_new_transfers(
         self, recs: np.ndarray, ts=None, add_bloom: bool = True
     ) -> None:
@@ -502,7 +509,7 @@ class StateMachine:
     # background storage work interleaved between commits, so the commit →
     # reply path itself performs no grid IO.
 
-    def compact_beat(self, max_blocks: int = 8, flush: bool = True) -> None:
+    def compact_beat(self, max_blocks: int = 8, flush: bool = True) -> None:  # tidy: thread=commit|store
         """One beat of deferred storage work: flush up to `max_blocks` of
         the object log's pending blocks and run one bounded compaction
         step on each durable index. Driven once per committed op from

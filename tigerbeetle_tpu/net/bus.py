@@ -18,6 +18,7 @@ import traceback
 from typing import Dict, List, Optional, Tuple
 
 from tigerbeetle_tpu import tracer
+from tigerbeetle_tpu.tidy import runtime as tidy_runtime
 from tigerbeetle_tpu.vsr.header import HEADER_SIZE, Command, Header, Message
 
 log = logging.getLogger("tigerbeetle_tpu.bus")
@@ -41,18 +42,30 @@ class _Conn:
         Command.PING, Command.PONG, Command.PING_CLIENT, Command.PONG_CLIENT,
     ))
 
+    # Send-queue gauge sampling: recording every send would take the
+    # tracer registry lock per outbound message on the event loop; one
+    # sample every 64 sends (plus every drop) tracks the trend at 1/64th
+    # the cost.
+    SENDQ_SAMPLE_MASK = 63
+
     def __init__(self, writer: asyncio.StreamWriter) -> None:
         self.writer = writer
-        self.dropped = 0
+        self.dropped = 0  # tidy: owner=loop
+        self._sends = 0  # tidy: owner=loop
         # Per-connection gauge identity (a single global would flap
         # between unrelated transports); the name is prebuilt so the hot
-        # send path does no string formatting.
+        # send path does no string formatting. Retired via close_gauge()
+        # when the connection unmaps — ephemeral client ports would
+        # otherwise grow the gauge registry (and every scrape) forever.
         peer = writer.get_extra_info("peername")
         self._sendq_gauge = (
             f"bus.send_queue_bytes.{peer[0]}:{peer[1]}"
             if isinstance(peer, tuple) and len(peer) >= 2
             else "bus.send_queue_bytes.unknown"
         )
+
+    def close_gauge(self) -> None:
+        tracer.remove_gauge(self._sendq_gauge)
 
     def _can_send(self, size: int, command: Optional[int] = None) -> bool:
         """Backpressure guard: drop (and count) when the peer's send
@@ -68,8 +81,11 @@ class _Conn:
         buffered = (
             transport.get_write_buffer_size() if transport is not None else 0
         )
-        tracer.gauge(self._sendq_gauge, buffered)
-        if transport is not None and buffered + size > limit:
+        self._sends += 1
+        over = transport is not None and buffered + size > limit
+        if over or (self._sends & self.SENDQ_SAMPLE_MASK) == 0:
+            tracer.gauge(self._sendq_gauge, buffered)
+        if over:
             self.dropped += 1
             tracer.count("bus.dropped_messages")
             if self.dropped == 1 or self.dropped % 1000 == 0:
@@ -104,7 +120,10 @@ async def read_message(reader: asyncio.StreamReader) -> Optional[Message]:
     global _algo_mismatch_logged
     try:
         hraw = await reader.readexactly(HEADER_SIZE)
-    except (asyncio.IncompleteReadError, ConnectionError):
+    except (asyncio.IncompleteReadError, OSError):
+        # OSError covers the whole socket-failure family (ConnectionError,
+        # ETIMEDOUT, ENETUNREACH): any of them must end THIS read loop
+        # cleanly, not kill the caller's reconnect task.
         return None
     h = Header.from_bytes(hraw)
     if not h.valid_checksum():
@@ -130,7 +149,7 @@ async def read_message(reader: asyncio.StreamReader) -> Optional[Message]:
     if size > HEADER_SIZE:
         try:
             body = await reader.readexactly(size - HEADER_SIZE)
-        except (asyncio.IncompleteReadError, ConnectionError):
+        except (asyncio.IncompleteReadError, OSError):
             return None
     msg = Message(h, body)
     with tracer.span("stage.parse"):
@@ -157,8 +176,11 @@ class ReplicaServer:
         # standby keeps its listener but speaks (and self-routes) as its
         # new active index.
         self.me = replica.replica
-        self.peer_conns: Dict[int, _Conn] = {}
-        self.client_conns: Dict[int, _Conn] = {}
+        # Connection routing maps: event-loop-owned, like every other piece
+        # of VSR protocol state — worker stages must post back to the loop
+        # rather than send directly.
+        self.peer_conns: Dict[int, _Conn] = {}  # tidy: owner=loop
+        self.client_conns: Dict[int, _Conn] = {}  # tidy: owner=loop
         self._server: Optional[asyncio.base_events.Server] = None
         self._stopping = asyncio.Event()
         # Overlapped commit pipeline (docs/COMMIT_PIPELINE.md): WAL writer
@@ -216,6 +238,7 @@ class ReplicaServer:
     STREAM_LIMIT = 1 << 21
 
     async def start(self) -> None:
+        tidy_runtime.stamp("loop")
         host, port = self.addresses[self.me]
         self._server = await asyncio.start_server(
             self._on_accept, host, port, limit=self.STREAM_LIMIT
@@ -299,8 +322,15 @@ class ReplicaServer:
                        cluster=self.replica.cluster)
             ).seal()
             writer.write(hello.to_bytes())
-            await self._read_loop(reader, expected_replica=r)
-            self.peer_conns.pop(r, None)
+            conn = self.peer_conns[r]
+            try:
+                await self._read_loop(reader, expected_replica=r)
+            finally:
+                # Unmap + retire the gauge on EVERY exit (a raised
+                # dispatch included) so the next loop iteration
+                # reconnects against clean state.
+                self.peer_conns.pop(r, None)
+                conn.close_gauge()
 
     async def _on_accept(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
@@ -359,6 +389,7 @@ class ReplicaServer:
                 del self.client_conns[cid]
         if peer_replica is not None and self.peer_conns.get(peer_replica) is conn:
             del self.peer_conns[peer_replica]
+        conn.close_gauge()
         writer.close()
 
     async def _read_loop(self, reader: asyncio.StreamReader, expected_replica: int) -> None:

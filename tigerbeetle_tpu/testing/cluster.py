@@ -185,6 +185,12 @@ class Cluster:
         overlap: bool = False,
         store_async: bool = False,
     ) -> None:
+        # The sim main thread IS the event loop: stamp it so the runtime
+        # affinity assertions (tidy/runtime.py, enabled by the
+        # determinism tests) can tell it apart from the worker stages.
+        from tigerbeetle_tpu.tidy import runtime as tidy_runtime
+
+        tidy_runtime.stamp("loop")
         self.cluster_id = 0xC1
         # overlap=True attaches a real CommitExecutor thread to every
         # replica (the overlapped commit stage, vsr/pipeline.py); its

@@ -1,0 +1,69 @@
+"""Thread-ownership & determinism analyzer for the 4-thread commit pipeline.
+
+The reference enforces its invariants with a compile-time tidy pass
+(tidy.zig); this package is the analog grown for the Python port's
+concurrency: the event loop plus three worker threads (WalWriter,
+CommitExecutor, StoreExecutor) share hand-maintained ownership rules
+("commit-thread-owned", "publish-then-retire") that used to live only in
+comments. Three passes turn them into checked invariants:
+
+  - `ownership` — a lockset-style static pass (in the spirit of Eraser,
+    Savage et al. 1997): structured `# tidy:` annotations declare the
+    owning thread role or guarding lock for mutable attributes of the
+    pipeline-coupled classes; the pass computes per-method attribute
+    read/write sets, resolves which thread role each method runs on
+    (worker `_run` bodies by thread name, `thread=` annotations,
+    intra-class call-graph propagation), and flags any cross-thread
+    access that is not inside a `with <lock>:` scope, behind a declared
+    barrier, or covered by an explicit declaration.
+  - `determinism` — a lint over the deterministic core (models/, lsm/,
+    vsr/ minus clock.py, ops/): every replica must be a pure function of
+    (state, ordered batch), so wall-clock reads, `random`, `os.urandom`,
+    env reads, `id()`-derived values, set-iteration ordering, and float
+    accumulation on state are banned (explicit `allow=` escapes carry a
+    reason).
+  - `markers` — source hygiene (the original tidy.zig test family):
+    banned stub/debug markers and module docstrings, now covering
+    tools/, tests/, and the top-level scripts.
+
+Findings are suppressed either inline (`# tidy: allow=<code> <reason>`)
+or via the checked-in baseline (baseline.json) so existing intentional
+patterns are explicit, not silence. `tidy/runtime.py` adds the fourth,
+dynamic leg: env-gated thread-affinity and lock-order assertions wired
+into the pipeline hot paths (no-op when disabled, like the tracer's
+null span).
+
+Run `python tools/tidy_check.py` locally; docs/STATIC_ANALYSIS.md has
+the annotation syntax and the baseline workflow.
+"""
+
+from tigerbeetle_tpu.tidy.findings import (  # noqa: F401
+    Finding,
+    baseline_path,
+    load_baseline,
+    write_baseline,
+)
+
+
+def run_passes(root=None, passes=None):
+    """Run the selected static passes (default: all) over the repo rooted
+    at `root` (default: the checkout containing this package). Returns a
+    list of Finding, sorted by (file, line)."""
+    import pathlib
+
+    from tigerbeetle_tpu.tidy import determinism, markers, ownership
+
+    if root is None:
+        root = pathlib.Path(__file__).resolve().parents[2]
+    root = pathlib.Path(root)
+    all_passes = {
+        "ownership": ownership.run,
+        "determinism": determinism.run,
+        "markers": markers.run,
+    }
+    selected = passes if passes is not None else list(all_passes)
+    findings = []
+    for name in selected:
+        findings.extend(all_passes[name](root))
+    findings.sort(key=lambda f: (f.file, f.line, f.code))
+    return findings

@@ -1,0 +1,103 @@
+"""The thread-topology manifest: what the ownership pass analyzes.
+
+The pipeline runs four thread roles (docs/COMMIT_PIPELINE.md):
+
+  - `loop`   — the asyncio event loop (or the simulator/test main
+               thread standing in for it): all VSR protocol state.
+  - `wal`    — the WalWriter thread (vsr/journal.py): durable WAL
+               writes.
+  - `commit` — the commit-execution context: the CommitExecutor thread
+               when the overlapped stage is attached, the event loop
+               itself on the serial fallback. State-machine execution
+               and everything "commit-thread-owned" lives here.
+  - `store`  — the StoreExecutor thread: deferred groove/index writes
+               and compaction beats.
+
+A class is analyzed when it appears here or carries any `# tidy:`
+annotation. Method→role resolution order: `thread=` annotation on the
+def, a `threading.Thread(target=self._x, name=...)` construction (the
+name maps through THREAD_NAME_ROLES), the METHOD_ROLES entry below,
+intra-class call-graph propagation from resolved methods, and finally
+the class's default role. Cross-class call edges are NOT traced — the
+role of a public entry point is a declaration (exactly the ownership
+comment it replaces), which keeps the pass honest and the annotations
+load-bearing.
+"""
+
+from __future__ import annotations
+
+ROLES = frozenset(("loop", "wal", "commit", "store", "any"))
+
+# threading.Thread(name=...) literal -> role of its target method.
+THREAD_NAME_ROLES = {
+    "wal-writer": "wal",
+    "commit-executor": "commit",
+    "store-executor": "store",
+}
+
+# Barrier callables (names) accepted by `barrier=` annotations: a
+# cross-thread access ordered by one of these is sequenced, not racing.
+BARRIERS = frozenset(("store_barrier", "drain", "wait", "quiesce", "join"))
+
+# (repo-relative file, class) -> default role set ("|"-joined) for
+# methods the resolution steps above leave unassigned. These are the
+# pipeline-coupled classes named in the ownership design; annotated
+# classes not listed here default to "loop". A multi-role default
+# (DurableIndex, Grid) says "this object is shared between the commit
+# and store contexts wholesale" — its attributes then REQUIRE explicit
+# declarations, which is the point.
+OWNERSHIP_CLASSES = {
+    ("tigerbeetle_tpu/vsr/pipeline.py", "CommitExecutor"): "loop",
+    ("tigerbeetle_tpu/vsr/pipeline.py", "StoreExecutor"): "loop",
+    ("tigerbeetle_tpu/vsr/journal.py", "WalWriter"): "loop",
+    ("tigerbeetle_tpu/lsm/tree.py", "DurableIndex"): "commit|store",
+    ("tigerbeetle_tpu/models/state_machine.py", "StateMachine"): "commit",
+    ("tigerbeetle_tpu/io/grid.py", "Grid"): "commit|store",
+    ("tigerbeetle_tpu/net/bus.py", "_Conn"): "loop",
+    ("tigerbeetle_tpu/net/bus.py", "ReplicaServer"): "loop",
+}
+
+# Modules whose top-level mutable globals are ownership-checked the same
+# way (functions stand in for methods; `with <lockname>:` scopes count).
+# value = default role for the module's functions.
+OWNERSHIP_MODULES = {
+    "tigerbeetle_tpu/tracer.py": "any",
+}
+
+# --- determinism lint scope ---------------------------------------------
+
+# The deterministic core: every replica must be a pure function of
+# (state, ordered batch). vsr/clock.py is the ONE sanctioned wall-clock
+# reader (Marzullo-synchronized timestamps enter state only through the
+# primary's prepare headers, which the batch carries).
+DETERMINISM_INCLUDE = (
+    "tigerbeetle_tpu/models",
+    "tigerbeetle_tpu/lsm",
+    "tigerbeetle_tpu/vsr",
+    "tigerbeetle_tpu/ops",
+)
+DETERMINISM_EXCLUDE = ("tigerbeetle_tpu/vsr/clock.py",)
+
+# --- marker scan scope ---------------------------------------------------
+
+# Directories / top-level scripts covered by the banned-marker scan.
+# tests/fixtures is excluded: fixture modules deliberately contain
+# violations for the analyzer's own test suite.
+MARKER_SCAN_DIRS = ("tigerbeetle_tpu", "tools", "tests")
+MARKER_SCAN_FILES = ("bench.py", "profile_e2e.py", "profile_exact.py", "__graft_entry__.py")
+MARKER_SCAN_EXCLUDE_DIRS = ("tests/fixtures",)
+
+# Stub markers and debug leftovers (the reference tidy.zig banned-word
+# family). Spelled split so this file never matches its own scan.
+BANNED_MARKERS = (
+    "NotImplemented" + "Error",
+    "TO" + "DO",
+    "FIX" + "ME",
+    "X" + "XX",
+    "breakpoint" + "(",
+    "import" + " pdb",
+)
+
+# Module-docstring requirement applies to the package only (tests and
+# tools document themselves more loosely).
+DOCSTRING_SCAN_DIRS = ("tigerbeetle_tpu",)

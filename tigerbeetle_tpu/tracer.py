@@ -48,6 +48,8 @@ import time
 from array import array
 from typing import Dict, List, Optional, Tuple
 
+from tigerbeetle_tpu.tidy import runtime as tidy_runtime
+
 _enabled = os.environ.get("TIGERBEETLE_TPU_TRACE", "") not in ("", "0")
 
 # --- histogram geometry (log-linear, HDR-lite) --------------------------
@@ -88,10 +90,14 @@ def bucket_value(idx: int) -> int:
 RING_DEFAULT = 1 << 15  # span records per thread (~0.75 MiB each)
 
 _ring_size = int(os.environ.get("TIGERBEETLE_TPU_TRACE_RING", RING_DEFAULT))
-_registry_lock = threading.Lock()
-_states: List["_ThreadState"] = []
+_registry_lock = tidy_runtime.make_lock("tracer.registry")
+_states: List["_ThreadState"] = []  # tidy: guarded-by=_registry_lock
 _generation = 0
-_gauges: Dict[str, float] = {}
+# Gauges are last-write-wins from ANY thread (stage depths are set by the
+# loop, the commit thread, and the store thread) while the metrics scrape
+# iterates on the loop — so even the single-key set takes the lock: an
+# unlocked dict resize racing `sorted(_gauges)` raises RuntimeError.
+_gauges: Dict[str, float] = {}  # tidy: guarded-by=_registry_lock
 _tls = threading.local()
 
 
@@ -271,11 +277,22 @@ def gauge(name: str, value: float) -> None:
     """Set a last-write-wins gauge (queue depths, table counts)."""
     if not _enabled:
         return
-    _gauges[name] = value
+    with _registry_lock:
+        _gauges[name] = value
+
+
+def remove_gauge(name: str) -> None:
+    """Retire a gauge whose identity died (a closed connection's send
+    queue): per-instance gauge families must not grow without bound."""
+    if not _enabled:
+        return
+    with _registry_lock:
+        _gauges.pop(name, None)
 
 
 def gauges() -> Dict[str, float]:
-    return dict(_gauges)
+    with _registry_lock:
+        return dict(_gauges)
 
 
 # --- merge / snapshot ---------------------------------------------------
@@ -477,9 +494,10 @@ def prometheus_text() -> str:
         "# HELP tbtpu_gauge Gauge registry (queue depths, table counts).",
         "# TYPE tbtpu_gauge gauge",
     ]
-    for name in sorted(_gauges):
+    g = gauges()  # locked snapshot: worker threads set gauges mid-scrape
+    for name in sorted(g):
         lines.append(
-            f'tbtpu_gauge{{name="{_label_escape(name)}"}} {_gauges[name]:.9g}'
+            f'tbtpu_gauge{{name="{_label_escape(name)}"}} {g[name]:.9g}'
         )
     return "\n".join(lines) + "\n"
 
@@ -494,11 +512,17 @@ async def serve_metrics(port: int, host: str = "127.0.0.1"):
 
     async def _handle(reader, writer) -> None:
         try:
-            req = await reader.readline()
-            while True:
-                line = await reader.readline()
-                if not line or line in (b"\r\n", b"\n"):
-                    break
+            # Bounded header read: a half-open probe (port scan, LB health
+            # check that never sends) must not pin a coroutine + socket on
+            # the replica's event loop forever.
+            async def _headers():
+                req = await reader.readline()
+                while True:
+                    line = await reader.readline()
+                    if not line or line in (b"\r\n", b"\n"):
+                        return req
+
+            req = await asyncio.wait_for(_headers(), timeout=10)
             parts = req.split()
             path = parts[1].decode("latin-1") if len(parts) >= 2 else "/"
             status = "200 OK"
@@ -519,7 +543,7 @@ async def serve_metrics(port: int, host: str = "127.0.0.1"):
                 ).encode() + body
             )
             await writer.drain()
-        except (ConnectionError, asyncio.IncompleteReadError):
+        except (ConnectionError, asyncio.IncompleteReadError, asyncio.TimeoutError):
             pass
         finally:
             try:

@@ -139,7 +139,7 @@ def _select_checksum():
     picking the wrong algorithm would present as data corruption)."""
     import os
 
-    choice = os.environ.get("TIGERBEETLE_TPU_CHECKSUM", "auto")
+    choice = os.environ.get("TIGERBEETLE_TPU_CHECKSUM", "auto")  # tidy: allow=env-read — import-time config; must be cluster-uniform (bus.py logs the split-cluster case loudly)
     if choice not in ("auto", "aegis", "aegis128l", "blake2b"):
         raise ValueError(
             f"TIGERBEETLE_TPU_CHECKSUM={choice!r}: expected auto|aegis|blake2b"

@@ -16,6 +16,7 @@ from typing import Callable, Dict, List, Optional
 
 from tigerbeetle_tpu import tracer
 from tigerbeetle_tpu.io.storage import Zone
+from tigerbeetle_tpu.tidy import runtime as tidy_runtime
 from tigerbeetle_tpu.vsr.header import HEADER_SIZE, Command, Header, Message
 
 
@@ -45,18 +46,19 @@ class WalWriter:
     def __init__(self, storage, post: Callable[[Callable[[], None]], None]) -> None:
         self._storage = storage
         self._post = post
-        self._cond = threading.Condition()
+        self._cond = tidy_runtime.make_condition("wal.cond")
         # (segments, cb); segments None = barrier, else a list of
         # (offset, chunks, durable) writes performed in order.
-        self._pending: List[tuple] = []
-        self._busy = False  # an item is mid-write (for drain())
-        self._stopped = False
+        self._pending: List[tuple] = []  # tidy: guarded-by=_cond
+        self._busy = False  # tidy: guarded-by=_cond
+        self._stopped = False  # tidy: guarded-by=_cond
         self._thread = threading.Thread(
             target=self._run, name="wal-writer", daemon=True
         )
         self._thread.start()
 
     def submit(self, segments, cb: Callable[[], None]) -> None:
+        tidy_runtime.assert_role("loop")
         with self._cond:
             self._pending.append((segments, cb))
             tracer.gauge("pipeline.wal.depth", len(self._pending))
@@ -90,6 +92,7 @@ class WalWriter:
     def _run(self) -> None:
         from tigerbeetle_tpu.vsr.pipeline import _timed_wait
 
+        tidy_runtime.stamp("wal")
         while True:
             with self._cond:
                 while not self._pending and not self._stopped:

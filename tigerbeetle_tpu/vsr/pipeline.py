@@ -47,6 +47,7 @@ from collections import deque
 from typing import Callable, List, Optional, Tuple
 
 from tigerbeetle_tpu import tracer
+from tigerbeetle_tpu.tidy import runtime as tidy_runtime
 
 # Max jobs popped per cycle (keeps park/reset bookkeeping bounded).
 RUN_MAX = 8
@@ -59,9 +60,9 @@ def _timed_wait(cond: threading.Condition, event: str) -> None:
     if not tracer.enabled():
         cond.wait()
         return
-    t0 = time.perf_counter_ns()
+    t0 = time.perf_counter_ns()  # tidy: allow=wall-clock — tracing only, never reaches state
     cond.wait()
-    tracer.observe(event, time.perf_counter_ns() - t0)
+    tracer.observe(event, time.perf_counter_ns() - t0)  # tidy: allow=wall-clock — tracing only, never reaches state
 
 
 class CommitExecutor:
@@ -78,12 +79,13 @@ class CommitExecutor:
         # Posted to the loop after completions land on the done deque —
         # the replica's completion drainer (applies state in op order).
         self._notify = notify if notify is not None else (lambda: None)
-        self._cond = threading.Condition()
-        self._pending: deque = deque()
+        self._cond = tidy_runtime.make_condition("commit.cond")
+        self._pending: deque = deque()  # tidy: guarded-by=_cond
+        # tidy: atomic — GIL-atomic deque handoff: worker appends, loop pops
         self._done: deque = deque()
-        self._busy = False
-        self._parked = False
-        self._stopped = False
+        self._busy = False  # tidy: guarded-by=_cond
+        self._parked = False  # tidy: guarded-by=_cond
+        self._stopped = False  # tidy: guarded-by=_cond
         self._thread = threading.Thread(
             target=self._run, name="commit-executor", daemon=True
         )
@@ -92,6 +94,7 @@ class CommitExecutor:
     # --- event-loop side -------------------------------------------------
 
     def submit(self, job: dict) -> None:
+        tidy_runtime.assert_role("loop")
         with self._cond:
             self._pending.append(job)
             tracer.gauge("pipeline.commit.depth", len(self._pending))
@@ -100,6 +103,7 @@ class CommitExecutor:
     def pop_done(self) -> Optional[dict]:
         """Next completed job, in completion (= op) order; None when empty.
         Thread-safe: the worker appends, the event loop pops."""
+        tidy_runtime.assert_role("loop")
         try:
             return self._done.popleft()
         except IndexError:
@@ -130,7 +134,7 @@ class CommitExecutor:
         return out
 
     @property
-    def parked(self) -> bool:
+    def parked(self) -> bool:  # tidy: allow=unlocked-access — racy read by design, re-checked under the lock by every consumer
         return self._parked
 
     def stop(self) -> None:
@@ -140,9 +144,10 @@ class CommitExecutor:
 
     # --- worker-thread side ----------------------------------------------
 
-    def complete(self, job: dict) -> None:
+    def complete(self, job: dict) -> None:  # tidy: thread=commit
         """Publish one completion (called by `process` the moment an op's
         reply is ready — before its deferred storage work)."""
+        tidy_runtime.assert_role("commit")
         self._done.append(job)
         self._post(self._notify)
 
@@ -171,6 +176,7 @@ class CommitExecutor:
             self._cond.notify_all()
 
     def _run(self) -> None:
+        tidy_runtime.stamp("commit")
         while True:
             with self._cond:
                 while (not self._pending or self._parked) and not self._stopped:
@@ -247,16 +253,20 @@ class StoreExecutor:
         self._post = post
         self._notify = notify if notify is not None else (lambda: None)
         self._depth_max = depth_max
-        self._cond = threading.Condition()
-        self._pending: deque = deque()
+        self._cond = tidy_runtime.make_condition("store.cond")
+        self._pending: deque = deque()  # tidy: guarded-by=_cond
+        # tidy: atomic — GIL-atomic deque handoff: worker appends, loop pops
         self._done: deque = deque()
         # The job popped for processing (in-flight): part of the pending
         # write buffer until its store phase lands (job["stored"]).
-        self._current: Optional[dict] = None
-        self._busy = False
-        self._parked = False
-        self._stopped = False
-        self.fault: Optional[BaseException] = None
+        self._current: Optional[dict] = None  # tidy: guarded-by=_cond
+        self._busy = False  # tidy: guarded-by=_cond
+        self._parked = False  # tidy: guarded-by=_cond
+        self._stopped = False  # tidy: guarded-by=_cond
+        # Published under _cond by the worker; the commit thread reads it
+        # lock-free AFTER drain() returned parked (store_barrier) — the
+        # park flag is the publication barrier.
+        self.fault: Optional[BaseException] = None  # tidy: guarded-by=_cond
         self._thread = threading.Thread(
             target=self._run, name="store-executor", daemon=True
         )
@@ -264,7 +274,8 @@ class StoreExecutor:
 
     # --- producer side (commit thread / event loop) ----------------------
 
-    def submit(self, job: dict) -> None:
+    def submit(self, job: dict) -> None:  # tidy: thread=commit|loop
+        tidy_runtime.assert_role("commit", "loop")
         with self._cond:
             while (
                 len(self._pending) >= self._depth_max
@@ -286,7 +297,7 @@ class StoreExecutor:
             tracer.gauge("pipeline.store.depth", len(self._pending))
             self._cond.notify_all()
 
-    def drain(self) -> None:
+    def drain(self) -> None:  # tidy: thread=commit|loop
         """Block until every queued job ran, or the stage parked on a
         fault (check `parked`/`fault` after — a parked stage holds jobs
         that will resume after grid repair)."""
@@ -323,12 +334,13 @@ class StoreExecutor:
         return out
 
     def pop_done(self) -> Optional[dict]:
+        tidy_runtime.assert_role("loop")
         try:
             return self._done.popleft()
         except IndexError:
             return None
 
-    def unapplied_stores(self) -> List[tuple]:
+    def unapplied_stores(self) -> List[tuple]:  # tidy: thread=commit|loop
         """Snapshot of the PENDING WRITE BUFFER: (recs, ts) store
         payloads of queued + in-flight jobs whose index/log writes have
         not landed yet. Readers racing the stage consult this first,
@@ -346,11 +358,11 @@ class StoreExecutor:
         ]
 
     @property
-    def parked(self) -> bool:
+    def parked(self) -> bool:  # tidy: allow=unlocked-access — racy read by design, re-checked under the lock by every consumer
         return self._parked
 
     @property
-    def idle(self) -> bool:
+    def idle(self) -> bool:  # tidy: thread=commit|loop
         with self._cond:
             return not self._pending and not self._busy and not self._parked
 
@@ -372,6 +384,7 @@ class StoreExecutor:
             self._cond.notify_all()
 
     def _run(self) -> None:
+        tidy_runtime.stamp("store")
         while True:
             with self._cond:
                 while (not self._pending or self._parked) and not self._stopped:
