@@ -109,7 +109,17 @@ def _staged_fns(commit_ops, jnp, jax, n, n_accounts, zipf_cdf=None):
 
 def _run_staged_windows(jax, jnp, gen_window, commit_window, state, key,
                         windows=WINDOWS):
-    """Generate each window untimed, then time the commit dispatches."""
+    """Generate each window untimed, then time the commit dispatches.
+
+    Returns (posted, elapsed_s, steady_compiles): the compile count is
+    the number of XLA compiles INSIDE the timed loop (tidy/jaxlint.py
+    CompileRegistry) — zero in a healthy run, since the warmup call
+    compiles every bucket. bench records it per workload and
+    tools/bench_gate.py gates it exactly (a retrace regression fails CI
+    like a perf drop)."""
+    from tigerbeetle_tpu.tidy.jaxlint import compile_registry
+
+    compile_registry.install()
     key, batches = gen_window(key, jnp.uint32(0))
     jax.block_until_ready(batches)
     state_w, posted, bail = commit_window(state, batches)  # warmup
@@ -121,6 +131,7 @@ def _run_staged_windows(jax, jnp, gen_window, commit_window, state, key,
         key, batches = gen_window(key, jnp.uint32((w + 1) * SCAN_BATCHES))
         staged.append(batches)
     jax.block_until_ready(staged)
+    compile_snap = compile_registry.snapshot()
     posteds, bails = [], []
     t0 = time.perf_counter()
     for batches in staged:
@@ -129,9 +140,10 @@ def _run_staged_windows(jax, jnp, gen_window, commit_window, state, key,
         bails.append(bail)
     jax.block_until_ready(state)
     elapsed = time.perf_counter() - t0
+    steady_compiles = compile_registry.total_delta(compile_snap)
     total = sum(int(p) for p in posteds)
     assert not any(bool(b) for b in bails)
-    return total, elapsed
+    return total, elapsed, steady_compiles
 
 
 def bench_config1():
@@ -160,7 +172,7 @@ def bench_config1():
         commit_ops, jnp, jax, BATCH, N_ACCOUNTS
     )
     key = jax.random.PRNGKey(0xBEE)
-    total_posted, elapsed = _run_staged_windows(
+    total_posted, elapsed, steady_compiles = _run_staged_windows(
         jax, jnp, gen_window, commit_window, state, key
     )
     batches = WINDOWS * SCAN_BATCHES
@@ -170,6 +182,7 @@ def bench_config1():
         "batches": batches,
         "accounts": N_ACCOUNTS,
         "accounts_max": accounts_max,
+        "steady_compiles": steady_compiles,
     }
 
 
@@ -213,7 +226,7 @@ def bench_config2_zipf():
         commit_ops, jnp, jax, BATCH, n_accounts, zipf_cdf=zipf_cdf
     )
     key = jax.random.PRNGKey(0x21F)
-    total_posted, elapsed = _run_staged_windows(
+    total_posted, elapsed, steady_compiles = _run_staged_windows(
         jax, jnp, gen_window, commit_window, state, key, windows=4
     )
     batches = 4 * SCAN_BATCHES
@@ -222,6 +235,7 @@ def bench_config2_zipf():
         "batch_ms_avg": round(elapsed / batches * 1e3, 3),
         "accounts": n_accounts,
         "zipf_s": 1.1,
+        "steady_compiles": steady_compiles,
     }
 
 

@@ -45,8 +45,19 @@ class DummyBus:
         self.replies.append(msg)
 
 
-def main(backend="numpy", batches=40, overlap=True, store_async=True):
+def main(backend="numpy", batches=40, overlap=True, store_async=True,
+         warmup=2):
     tracer.enable()
+    # Compile-count guard (tidy/jaxlint.py CompileRegistry): after the
+    # warmup batches the measured window must be retrace-free — any new
+    # XLA compile inside it is a shape/dtype-instability bug, asserted
+    # below. The numpy backend never compiles; the registry then reports
+    # zeros without importing jax.
+    from tigerbeetle_tpu.tidy.jaxlint import compile_registry
+
+    if backend != "numpy":
+        compile_registry.install()
+        compile_registry.track_default_entries()
     tmp = tempfile.mkdtemp(prefix="tbtpu-prof-")
     path = os.path.join(tmp, "prof.tigerbeetle")
     config = config_by_name("production")
@@ -65,6 +76,9 @@ def main(backend="numpy", batches=40, overlap=True, store_async=True):
         zone=zone, config=config, bus=bus, sm_backend=backend,
     )
     replica.open()
+    ops = getattr(replica.state_machine, "_ops", None)
+    if ops is not None and hasattr(ops, "track_compiles"):
+        ops.track_compiles(compile_registry)  # mesh-built jit entries
 
     # The full pipeline (docs/COMMIT_PIPELINE.md): WAL writer + commit
     # executor + async store stage. Worker threads post loop-side
@@ -126,11 +140,14 @@ def main(backend="numpy", batches=40, overlap=True, store_async=True):
         settle(n_before + 1)
 
     # Pre-marshal request bodies (client-side cost measured separately).
+    # The first `warmup` batches are fed before the measured window so
+    # every kernel bucket is compiled; the window itself must then be
+    # compile-free (asserted after the run).
     rng = np.random.default_rng(7)
     bodies = []
     next_id = 1
     t0 = time.perf_counter()
-    for _ in range(batches):
+    for _ in range(batches + warmup):
         ev = np.zeros(BATCH, dtype=types.TRANSFER_DTYPE)
         ev["id_lo"] = np.arange(next_id, next_id + BATCH, dtype=np.uint64)
         next_id += BATCH
@@ -148,6 +165,15 @@ def main(backend="numpy", batches=40, overlap=True, store_async=True):
     t0 = time.perf_counter()
     msgs = [request(Operation.CREATE_TRANSFERS, b) for b in bodies]
     seal_s = time.perf_counter() - t0
+
+    # Warmup: compile every kernel bucket outside the measured window.
+    n_warm = len(bus.replies)
+    for m in msgs[:warmup]:
+        replica.on_message(m)
+        pump()
+    settle(n_warm + warmup)
+    msgs = msgs[warmup:]
+    compile_snap = compile_registry.snapshot()
 
     tracer.reset()  # measure only the transfer load (all threads re-arm)
     n0 = len(bus.replies)
@@ -179,10 +205,13 @@ def main(backend="numpy", batches=40, overlap=True, store_async=True):
     total_ms = snap["server.total"]["total_ms"]
     assert abs(total_ms / 1e3 - wall_s) / wall_s < 0.05, (total_ms, wall_s)
 
+    compile_delta = compile_registry.delta(compile_snap)
+    new_compiles = compile_registry.total_delta(compile_snap)
+
     print(f"backend={backend} batches={batches} overlap={overlap} "
-          f"store_async={store_async}")
-    print(f"client marshal: {marshal_s / batches * 1e3:.2f} ms/batch")
-    print(f"client seal:    {seal_s / batches * 1e3:.2f} ms/batch")
+          f"store_async={store_async} warmup={warmup}")
+    print(f"client marshal: {marshal_s / (batches + warmup) * 1e3:.2f} ms/batch")
+    print(f"client seal:    {seal_s / (batches + warmup) * 1e3:.2f} ms/batch")
     print(f"server total:   {total_ms / batches:.2f} ms/batch "
           f"({batches * BATCH / (total_ms / 1e3) / 1e6:.2f}M tx/s)")
     if store_async:
@@ -227,8 +256,10 @@ def main(backend="numpy", batches=40, overlap=True, store_async=True):
         stages.update(store_rows)
 
     reply_ms = snap.get("stage.reply", {}).get("total_ms", 0.0)
-    print("\nstage attribution (per batch; p50/p99 per span):")
-    header = f"  {'stage':12s} {'ms/batch':>9s} {'% wall':>7s} {'p50_us':>9s} {'p99_us':>9s}"
+    print("\nstage attribution (per batch; p50/p99 per span; compiles = jit "
+          "cache misses inside the measured window):")
+    header = (f"  {'stage':12s} {'ms/batch':>9s} {'% wall':>7s} "
+              f"{'p50_us':>9s} {'p99_us':>9s} {'compiles':>9s}")
     print(header)
     record = {}
     attributed = 0.0
@@ -242,11 +273,31 @@ def main(backend="numpy", batches=40, overlap=True, store_async=True):
         p50, p99 = span_pcts(keys)
         record[stage] = round(ms / batches, 3)
         record[f"{stage}_p99_us"] = p99
+        # Device kernels dispatch from the execute stage: it carries the
+        # window's total compile count; every other stage is host-only.
+        n_comp = new_compiles if stage == "execute" else 0
         print(f"  {stage:12s} {ms / batches:9.2f} {100 * ms / total_ms:6.1f}% "
-              f"{p50:9.1f} {p99:9.1f}")
+              f"{p50:9.1f} {p99:9.1f} {n_comp:9d}")
     other = total_ms - attributed
     record["other"] = round(other / batches, 3)
+    record["compiles"] = new_compiles
     print(f"  {'other':12s} {other / batches:9.2f} {100 * other / total_ms:6.1f}%")
+    per_entry = {
+        k: v for k, v in compile_delta.items()
+        if k != "__global__" and v
+    }
+    if per_entry:
+        print("  jit compiles by entry point: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(per_entry.items())
+        ))
+    # The measured window must be retrace-free: every kernel bucket is
+    # compiled during the warmup batches, so a nonzero count here is a
+    # shape/dtype-instability regression (the same invariant bench_gate
+    # enforces on recorded runs via steady_compiles).
+    assert new_compiles == 0, (
+        f"jit compiled {new_compiles} time(s) inside the measured window "
+        f"(per entry: {per_entry or compile_delta}) — retrace regression"
+    )
     # Dedup invariant 2 (serial commit only): with every commit-path row
     # on the loop thread, disjoint rows can never sum past the window —
     # a re-introduced double-counted region (the old execute-includes-
@@ -259,7 +310,8 @@ def main(backend="numpy", batches=40, overlap=True, store_async=True):
     if overlap or store_async:
         print("\nworker threads (off the commit path; overlaps the wall "
               "time above):")
-        print(header)
+        print(f"  {'stage':12s} {'ms/batch':>9s} {'% wall':>7s} "
+              f"{'p50_us':>9s} {'p99_us':>9s}")
         worker_rows = {"wal.write": ("wal.write",)}
         if store_async:
             worker_rows.update(store_rows)

@@ -1,0 +1,29 @@
+"""Fixture: limb arithmetic the interval interpreter must refuse to
+prove (plus one provable inverse). Findings asserted EXACTLY by
+tests/test_jaxlint.py — edit in lockstep."""
+
+import jax.numpy as jnp
+
+U32 = jnp.uint32
+
+
+def unsafe_add(a, b):
+    return a + b  # limb-overflow: full-range uint32 add may wrap
+
+
+def unsafe_shift(x):  # tidy: range=x:0..0xFFFF
+    return x << 20  # limb-overflow: 0xFFFF << 20 exceeds 2^32
+
+
+def unsafe_sub(a, b):
+    return a - b  # limb-underflow: may go below zero
+
+
+def overflowing_call(a, b):
+    s = a + b  # tidy: allow=limb-overflow — fixture: feeding a too-wide value onward
+    return unsafe_shift(s)  # range-obligation: exceeds the declared x range
+
+
+# tidy: range=a:0..0xFFFF,b:0..0xFFFF
+def safe_masked_add(a, b):
+    return a + b  # provable: ≤ 0x1FFFE, no finding

@@ -1,0 +1,14 @@
+"""Fixture: seeded nondeterministic reductions in a device kernel.
+Findings asserted EXACTLY by tests/test_jaxlint.py — edit in lockstep."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_reduce(table, idx, vals, seg_ids):
+    vf = vals.astype(jnp.float32)  # float-dtype: float in an integer kernel
+    out = table.at[idx].add(vf)  # unordered-reduce: float scatter-add
+    sums = jax.ops.segment_sum(vals, seg_ids)  # unordered-reduce
+    total = jax.lax.psum(out, {"dp", "shard"})  # axis-order: set of axes
+    return out, sums, total

@@ -1,0 +1,32 @@
+"""Fixture: seeded retrace hazards at jit-entry call sites. Findings
+asserted EXACTLY by tests/test_jaxlint.py — edit in lockstep."""
+
+import functools
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def merge_kernel(x):
+    return x * 2
+
+
+@functools.partial(jax.jit, static_argnames=("tile",))
+def merge_kernel_tiled(x, tile=128):
+    return x + tile
+
+
+def feed(events):
+    n = len(events)
+    a = merge_kernel(events[:n])  # retrace-shape: runtime-bounded slice
+    b = merge_kernel(np.asarray(events))  # retrace-shape: runtime-sized ctor
+    c = merge_kernel_tiled(a, tile=n * 2)  # retrace-static-arg: per-batch value
+    kw = {"x": b}
+    d = merge_kernel(**kw)  # retrace-kwargs: dict-ordered args
+    return a, b, c, d
+
+
+def feed_named(events):
+    tmp = np.zeros(len(events), dtype=np.uint32)  # retrace-shape fires HERE
+    return merge_kernel(tmp)  # ... when the named temporary reaches the entry

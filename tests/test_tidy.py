@@ -49,17 +49,20 @@ def test_repo_has_no_new_findings():
 
 
 def test_cli_json_mode():
-    """`tools/tidy_check.py --json` (the bench_gate-style automation
-    surface): exit 0 on the clean repo, parseable JSON with the full
-    finding/baseline split."""
+    """`tools/tidy_check.py --json` (now a thin alias for tools/check.py,
+    the single automation surface): exit 0 on the clean repo, parseable
+    JSON with the full finding/baseline split across EVERY pass."""
     proc = subprocess.run(
         [sys.executable, str(REPO / "tools" / "tidy_check.py"), "--json"],
-        capture_output=True, text=True, timeout=120,
+        capture_output=True, text=True, timeout=300,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     report = json.loads(proc.stdout)
     assert report["ok"] is True
-    assert set(report["passes"]) == {"ownership", "determinism", "markers"}
+    assert set(report["passes"]) == {
+        "ownership", "determinism", "markers",
+        "host-sync", "retrace", "reduction", "absint",
+    }
     assert isinstance(report["findings"], list)
 
 

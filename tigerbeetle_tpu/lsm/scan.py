@@ -62,6 +62,7 @@ def fold56(lo, hi=None) -> np.ndarray:
     return out & MASK56
 
 
+# tidy: range=tag:0..255,folded:0..0xFFFFFFFFFFFFFF — tag is the key's top byte; folded is a fold56 image (< 2^56), so tag<<56 | folded provably fits u64
 def composite_keys(tag: int, folded: np.ndarray, ts: np.ndarray) -> np.ndarray:
     """(tag<<56 | folded, timestamp) KEY_DTYPE rows."""
     keys = np.empty(len(folded), dtype=KEY_DTYPE)

@@ -547,12 +547,39 @@ class StateMachine:
     # ------------------------------------------------------------------
     # balances access (device or host backend)
 
+    @staticmethod
+    def _pad_slots(arrs, k: int, fills) -> list:
+        """Pad per-slot arrays to a power-of-two bucket (≥16) so the
+        balance-access jit entries compile once per bucket, not once per
+        lookup/registration size — found by the tidy retrace pass: every
+        distinct `len(slots)` used to be a fresh XLA compile (more
+        wall-clock than the gather it served). Fill values must be inert
+        for the kernel (an out-of-range slot under mode="drop", a False
+        mask)."""
+        n_pad = 1 << max(4, (max(k, 1) - 1).bit_length())
+        out = []
+        for a, fill in zip(arrs, fills):
+            a = np.atleast_1d(np.asarray(a))
+            if len(a) == n_pad:
+                out.append(a)
+                continue
+            p = np.full((n_pad, *a.shape[1:]), fill, dtype=a.dtype)
+            p[:k] = a
+            out.append(p)
+        return out
+
     def _read_balances(self, slots: np.ndarray):
         if self._ops is not None:
-            dp, dpo, cp, cpo = self._ops.read_balances(
-                self.state, np.asarray(slots, dtype=np.int32)
+            k = len(np.atleast_1d(slots))
+            # Pad slot 0 (clipped gather rows are sliced away below).
+            slots_p, = self._pad_slots(
+                [np.asarray(slots, dtype=np.int32)], k, [0]
             )
-            return (np.asarray(dp), np.asarray(dpo), np.asarray(cp), np.asarray(cpo))
+            dp, dpo, cp, cpo = self._ops.read_balances(self.state, slots_p)
+            return (
+                np.asarray(dp)[:k], np.asarray(dpo)[:k],
+                np.asarray(cp)[:k], np.asarray(cpo)[:k],
+            )
         s = np.asarray(slots, dtype=np.int64)
         hb = self._host_bal
         return (
@@ -562,8 +589,15 @@ class StateMachine:
 
     def _write_balances(self, slots, dp, dpo, cp, cpo) -> None:
         if self._ops is not None:
+            k = len(np.atleast_1d(slots))
+            # Pad rows scatter at slot=accounts_max → dropped (mode="drop").
+            oob = self.config.accounts_max
+            slots_p, dp_p, dpo_p, cp_p, cpo_p = self._pad_slots(
+                [np.asarray(slots, dtype=np.int32), dp, dpo, cp, cpo],
+                k, [oob, 0, 0, 0, 0],
+            )
             self.state = self._ops.write_balances(
-                self.state, np.asarray(slots, dtype=np.int32), dp, dpo, cp, cpo
+                self.state, slots_p, dp_p, dpo_p, cp_p, cpo_p
             )
         else:
             s = np.asarray(slots, dtype=np.int64)
@@ -575,12 +609,19 @@ class StateMachine:
 
     def _register_accounts(self, slots, ledger, flags, mask) -> None:
         if self._ops is not None:
+            k = len(np.atleast_1d(slots))
+            # Pad rows carry mask=False → never installed.
+            slots_p, ledger_p, flags_p, mask_p = self._pad_slots(
+                [
+                    np.asarray(slots, dtype=np.int32),
+                    np.asarray(ledger, dtype=np.uint32),
+                    np.asarray(flags, dtype=np.uint32),
+                    np.asarray(mask),
+                ],
+                k, [-1, 0, 0, False],
+            )
             self.state = self._ops.register_accounts(
-                self.state,
-                np.asarray(slots, dtype=np.int32),
-                np.asarray(ledger, dtype=np.uint32),
-                np.asarray(flags, dtype=np.uint32),
-                np.asarray(mask),
+                self.state, slots_p, ledger_p, flags_p, mask_p
             )
 
     # ------------------------------------------------------------------
@@ -1325,11 +1366,12 @@ class StateMachine:
             base_fulfillment=padp(pinfo_np["base_fulfillment"], commit_exact.FULFILL_NONE),
             group=padp(pinfo_np["group"], n_pad),
         )
-        chain_id_p = np.arange(n_pad, dtype=np.int32)
+        chain_id_p = np.arange(n_pad, dtype=np.int32)  # tidy: allow=retrace-shape — n_pad IS the bucket size (_device_batch's padded shape)
         chain_id_p[:n] = chain_id
 
         # Host-side sort plan: a ~100 µs numpy lexsort here replaces ~ms of
         # device lax.sort inside the kernel (SortPlan docstring).
+        # tidy: allow=retrace-shape — every input is n_pad-shaped (the padded batch b / padp outputs), so the plan's shapes are bucket-stable
         plan = commit_exact.build_sort_plan(
             np.asarray(b.flags), np.asarray(b.dr_slot), np.asarray(b.cr_slot),
             pinfo.dr_slot, pinfo.cr_slot, chain_id_p, pinfo.group,
@@ -1338,6 +1380,7 @@ class StateMachine:
         new_state, codes_dev, amounts_dev, dr_after, cr_after, bail = (
             self._ops.create_transfers_exact(
                 self.state, b, host_code_p, pinfo, chain_id_p, plan,
+                # tidy: allow=retrace-static-arg — deliberate bounded specialization: two bools → at most 4 kernel variants, each skipping a whole sweep phase
                 has_pv=bool(np.any(is_pv)), has_chains=bool(np.any(linked)),
             )
         )

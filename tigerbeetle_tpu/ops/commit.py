@@ -115,7 +115,7 @@ def merge_codes(code: jnp.ndarray, host_code: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(merged == big, jnp.uint32(0), merged)
 
 
-def _ladder(code, cond, result):
+def _ladder(code, cond, result):  # tidy: static=result — precedence constant (a TR enum member), never a traced value
     """One rung: where no earlier rung fired and cond holds, set `result`.
 
     Encodes the reference's precedence order (first failing check wins,

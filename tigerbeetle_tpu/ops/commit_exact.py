@@ -292,7 +292,7 @@ def _pending_expired(b: TransferBatch, p: PendingInfo):
     return (p.timeout != 0) & ~over & u128.ge(b.timestamp, deadline)
 
 
-def _axis_size(axis_name) -> int:
+def _axis_size(axis_name) -> int:  # tidy: static=axis_name|return — named-axis sizes are trace-time constants
     """Concrete named-axis size, portable across jax versions (the
     top-level jax.lax.axis_size is newer than some supported jaxes,
     whose core.axis_frame answers the same question)."""
@@ -303,6 +303,7 @@ def _axis_size(axis_name) -> int:
         return size if isinstance(size, int) else size.size
 
 
+# tidy: allow=float-dtype — the f32 MXU island is integer-exact by construction: lanes < 2^16 < 2^24 (f32 exact range) and precision=HIGHEST, see the note below
 def _exclusive_cumsum_mxu(vals: jnp.ndarray, axis_name: str | None = None) -> jnp.ndarray:
     """(m, k) u32 → exact exclusive prefix sums along axis 0, MXU-tiled.
 

@@ -49,7 +49,7 @@ from tigerbeetle_tpu.ops import u128
 I32 = jnp.int32
 
 
-def _bound(keys: jnp.ndarray, queries: jnp.ndarray, upper: bool) -> jnp.ndarray:
+def _bound(keys: jnp.ndarray, queries: jnp.ndarray, upper: bool) -> jnp.ndarray:  # tidy: static=upper — side selector, passed as a literal at every call site
     """Per-query count of `keys` elements < query (upper=False) or <= query
     (upper=True). keys (n, W) sorted ascending; queries (m, W)."""
     n = keys.shape[0]

@@ -51,9 +51,9 @@ def add(a, b):
     out = []
     carry = jnp.zeros(a.shape[:-1], dtype=U32)
     for i in range(w):
-        s = a[..., i] + b[..., i]
+        s = a[..., i] + b[..., i]  # tidy: allow=limb-overflow — intentional mod-2^32 wrap; the carry is recovered via s < a
         c1 = (s < a[..., i]).astype(U32)
-        s2 = s + carry
+        s2 = s + carry  # tidy: allow=limb-overflow — same wrap-and-recover trick for the carry-in
         c2 = (s2 < carry).astype(U32)
         out.append(s2)
         carry = c1 | c2  # a+b+carry_in < 2^33, so carry-out is 0 or 1
@@ -67,9 +67,9 @@ def sub(a, b):
     out = []
     borrow = jnp.zeros(a.shape[:-1], dtype=U32)
     for i in range(w):
-        d = a[..., i] - b[..., i]
+        d = a[..., i] - b[..., i]  # tidy: allow=limb-underflow — intentional mod-2^32 wrap; the borrow is recovered via a < b
         b1 = (a[..., i] < b[..., i]).astype(U32)
-        d2 = d - borrow
+        d2 = d - borrow  # tidy: allow=limb-underflow — same wrap-and-recover trick for the borrow-in
         b2 = (d < borrow).astype(U32)
         out.append(d2)
         borrow = b1 | b2
@@ -160,10 +160,12 @@ def mul_u32(a, b):
     hl = ah * bl
     hh = ah * bh
     # lo = ll + (lh << 16) + (hl << 16), tracking carries into hi.
-    m1 = ll + (lh << 16)
+    m1 = ll + (lh << 16)  # tidy: allow=limb-overflow — low half of the product wraps by design; carry recovered via m1 < ll
     c1 = (m1 < ll).astype(U32)
-    lo = m1 + (hl << 16)
+    lo = m1 + (hl << 16)  # tidy: allow=limb-overflow — same wrap-and-recover for the second partial product
     c2 = (lo < m1).astype(U32)
+    # Provably in-width (the interpreter checks it): hh ≤ (2^16-1)^2 and
+    # each >>16 term ≤ 2^16-2, so the sum is exactly ≤ 2^32-1.
     hi = hh + (lh >> 16) + (hl >> 16) + c1 + c2
     return jnp.stack([lo, hi], axis=-1)
 
@@ -185,11 +187,14 @@ def split_u16(limbs):
     return jnp.stack(parts, axis=-1)
 
 
+# tidy: range=halves:0..0xFFFE0001 — scatter-side contract: at most 2^16-1 contributions of ≤ 0xFFFF each (scatter_add/scatter_sub assert n < 2^16)
 def combine_u16(halves):
     """(..., 2W) uint32 u16-half accumulators → ((..., W) uint32 limbs, overflow).
 
     Propagates carries across half-limbs; each accumulator may hold up to
-    ~2^29, so the carry into the next half is `>> 16`.
+    ~2^29 in practice (≤ 0xFFFE0001 at the asserted bound — the entry
+    `range=` above is what the interval proof starts from), so the carry
+    into the next half is `>> 16` and every add below stays in-width.
     """
     w2 = halves.shape[-1]
     w = w2 // 2
@@ -223,6 +228,7 @@ def scatter_add(table, slots, values, mask):
     halves = split_u16(values)
     halves = jnp.where(mask[:, None], halves, jnp.zeros_like(halves))
     safe_slots = jnp.where(mask, slots, 0).astype(jnp.int32)
+    # tidy: range=acc:0..0xFFFE0001 — the assert above bounds the scatter to n < 2^16 contributions of u16 half-limbs
     acc = jnp.zeros((a, 2 * w), dtype=U32).at[safe_slots].add(
         halves, mode="drop", indices_are_sorted=False, unique_indices=False
     )
@@ -246,6 +252,7 @@ def scatter_sub(table, slots, values, mask):
     halves = split_u16(values)
     halves = jnp.where(mask[:, None], halves, jnp.zeros_like(halves))
     safe_slots = jnp.where(mask, slots, 0).astype(jnp.int32)
+    # tidy: range=acc:0..0xFFFE0001 — the assert above bounds the scatter to n < 2^16 contributions of u16 half-limbs
     acc = jnp.zeros((a, 2 * w), dtype=U32).at[safe_slots].add(
         halves, mode="drop", indices_are_sorted=False, unique_indices=False
     )

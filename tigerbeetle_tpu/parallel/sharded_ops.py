@@ -35,6 +35,15 @@ class ShardedOps:
         assert accounts_max == self.accounts_max
         return sharding.init_sharded_state(accounts_max, self.mesh)
 
+    def track_compiles(self, registry) -> None:
+        """Register the mesh-built jit entries with the tidy compile
+        registry (tidy/jaxlint.py CompileRegistry) so per-entry
+        cache-miss attribution covers the multi-chip path too — the
+        module-level defaults only see the single-chip entries."""
+        registry.track("sharded.create_transfers_fast", self._fast)
+        registry.track("sharded.create_transfers_exact", self._exact)
+        registry.track("sharded.create_transfers_exact_plan", self._exact_plan)
+
     def create_transfers_fast(self, state, b, host_code):
         # The fast step shards the batch over 'dp'; pad to a multiple.
         n = b.flags.shape[0]

@@ -157,6 +157,9 @@ def make_sharded_commit(mesh: Mesh, accounts_max: int):
             cr_pend=g_pend & cr_mine, cr_post=g_post & cr_mine,
         )
         bail_local = overflow | jnp.any(unsupported)
+        # Axis names MUST be an ordered tuple, never a set — collective
+        # reduction order is part of the determinism contract (the tidy
+        # reduction pass rejects set-valued axis arguments: axis-order).
         bail = jax.lax.psum(bail_local.astype(jnp.uint32), ("dp", "shard")) > 0
         return new_state, code, bail
 

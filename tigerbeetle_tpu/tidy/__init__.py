@@ -25,6 +25,15 @@ comments. Three passes turn them into checked invariants:
   - `markers` — source hygiene (the original tidy.zig test family):
     banned stub/debug markers and module docstrings, now covering
     tools/, tests/, and the top-level scripts.
+  - `host-sync` / `retrace` / `reduction` (tidy/jaxlint.py) — device
+    hot-path lints over the jitted kernels and their host dispatcher:
+    hidden device→host syncs outside the sanctioned dispatch/finish
+    seam, jit call sites that recompile per batch, and float/unordered
+    reductions that break byte-identical determinism.
+  - `absint` (tidy/absint.py) — interval abstract interpretation over
+    the u128 limb arithmetic and the fold56 key build: every + - * <<
+    is proven to stay within the limb width from `# tidy: range=`
+    entry annotations, or flagged.
 
 Findings are suppressed either inline (`# tidy: allow=<code> <reason>`)
 or via the checked-in baseline (baseline.json) so existing intentional
@@ -33,8 +42,11 @@ dynamic leg: env-gated thread-affinity and lock-order assertions wired
 into the pipeline hot paths (no-op when disabled, like the tracer's
 null span).
 
-Run `python tools/tidy_check.py` locally; docs/STATIC_ANALYSIS.md has
-the annotation syntax and the baseline workflow.
+Run `python tools/check.py` locally (tools/tidy_check.py remains as a
+thin alias); docs/STATIC_ANALYSIS.md has the annotation syntax and the
+baseline workflow. The compile-count runtime guard (jaxlint.
+CompileRegistry) is recorded by profile_e2e.py/bench.py and gated by
+tools/bench_gate.py.
 """
 
 from tigerbeetle_tpu.tidy.findings import (  # noqa: F401
@@ -45,13 +57,23 @@ from tigerbeetle_tpu.tidy.findings import (  # noqa: F401
 )
 
 
+def all_pass_names():
+    """Ordered tuple of every registered static pass."""
+    return (
+        "ownership", "determinism", "markers",
+        "host-sync", "retrace", "reduction", "absint",
+    )
+
+
 def run_passes(root=None, passes=None):
     """Run the selected static passes (default: all) over the repo rooted
     at `root` (default: the checkout containing this package). Returns a
     list of Finding, sorted by (file, line)."""
     import pathlib
 
-    from tigerbeetle_tpu.tidy import determinism, markers, ownership
+    from tigerbeetle_tpu.tidy import (
+        absint, determinism, jaxlint, markers, ownership,
+    )
 
     if root is None:
         root = pathlib.Path(__file__).resolve().parents[2]
@@ -60,10 +82,28 @@ def run_passes(root=None, passes=None):
         "ownership": ownership.run,
         "determinism": determinism.run,
         "markers": markers.run,
+        "absint": absint.run,
     }
-    selected = passes if passes is not None else list(all_passes)
+    selected = passes if passes is not None else list(all_pass_names())
+    unknown = [p for p in selected if p not in all_pass_names()]
+    if unknown:
+        # A typo must never silently disable a pass (the same rule the
+        # annotation parser enforces for clause keys).
+        raise ValueError(
+            f"unknown tidy pass(es) {unknown!r}; known: {all_pass_names()}"
+        )
     findings = []
+    # The device hot-path lints (PR 5: hidden host syncs, retrace
+    # hazards, nondeterministic reductions) share one module analysis —
+    # parse/hot-set/taint run once however many of the trio are
+    # selected. absint (the limb-width interval proofs) and the PR-4
+    # passes ride the same findings/baseline skeleton.
+    jax_selected = [p for p in selected
+                    if p in ("host-sync", "retrace", "reduction")]
+    if jax_selected:
+        findings.extend(jaxlint.run_selected(root, jax_selected))
     for name in selected:
-        findings.extend(all_passes[name](root))
+        if name in all_passes:
+            findings.extend(all_passes[name](root))
     findings.sort(key=lambda f: (f.file, f.line, f.code))
     return findings

@@ -1,0 +1,687 @@
+"""Interval abstract interpretation over the limb arithmetic.
+
+The u128/fold56 code paths do wide-integer math on narrow machine words
+(uint32 limbs on TPU, uint64 key words on the host), where a silent wrap
+is not an exception — it is a wrong balance that replicates itself into
+every checkpoint. This pass PROVES, per arithmetic operation, that the
+result stays within the limb width, from annotated entry ranges:
+
+  - Domain: unsigned intervals [lo, hi] per value, with a `host` flag
+    for Python-int/shape/index values (arbitrary precision — exempt
+    from width checks). Function parameters default to the full limb
+    range; `# tidy: range=name:lo..hi` on the def line narrows them
+    (the documented input contract, now machine-read). The same
+    annotation on an assignment line asserts a bound the analysis
+    cannot derive (e.g. a scatter-accumulation whose count bound lives
+    in an `assert` — the annotation carries the reason).
+  - Transfer functions: exact interval arithmetic for + - * << >> &
+    | ^ % //, bit-length bounds for the bitwise ops, hulls for
+    where/select/stack/concatenate, [0,1] for comparisons, fixed-point
+    iteration (bounded, with widening) for loop-carried carries.
+  - Checks: `limb-overflow` when + * << may exceed the width,
+    `limb-underflow` when - may go below zero, `range-obligation` when
+    a call argument may exceed the callee's declared `range=`.
+    Intentional wraps (the two's-complement carry tricks in add/sub)
+    carry `# tidy: allow=limb-overflow reason` — explicit, never
+    silent.
+
+Scope: manifest.ABSINT_TARGETS (ops/u128.py at width 32, lsm/scan.py's
+fold56 key build at width 64). `prove_file` returns the checked-op
+count so the test suite can assert the interpreter actually visited
+the arithmetic instead of skipping it.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from tigerbeetle_tpu.tidy import annotations as ann_mod
+from tigerbeetle_tpu.tidy import manifest
+from tigerbeetle_tpu.tidy.findings import Finding
+
+_WIDEN_AFTER = 64  # fixed-point iterations before widening to TOP
+
+
+@dataclass(frozen=True)
+class Iv:
+    """Unsigned interval. `host` marks Python-int/shape/index values
+    (no wrap semantics); `boolish` marks 0/1 predicates."""
+
+    lo: int
+    hi: int
+    host: bool = False
+
+    def join(self, other: "Iv") -> "Iv":
+        return Iv(min(self.lo, other.lo), max(self.hi, other.hi),
+                  self.host and other.host)
+
+
+def _top(width: int) -> Iv:
+    return Iv(0, (1 << width) - 1)
+
+
+BOOL = Iv(0, 1)
+HOST_TOP = Iv(0, 1 << 200, host=True)
+
+
+def _bitlen_bound(a: Iv, b: Iv) -> Iv:
+    bits = max(a.hi.bit_length(), b.hi.bit_length())
+    return Iv(0, (1 << bits) - 1 if bits else 0)
+
+
+def parse_ranges(ann) -> Dict[str, Iv]:
+    """`range=a:0..0xFF,b:10..20` → {name: Iv}. Malformed clauses raise
+    ValueError (reported as a bad-range finding by the caller)."""
+    out: Dict[str, Iv] = {}
+    v = ann.clauses.get("range")
+    if not v:
+        return out
+    for part in v.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, bounds = part.partition(":")
+        lo_s, sep, hi_s = bounds.partition("..")
+        if not sep:
+            raise ValueError(f"range clause {part!r} must be name:lo..hi")
+        out[name.strip()] = Iv(int(lo_s, 0), int(hi_s, 0))
+    return out
+
+
+class _FnAnalysis:
+    """One function body interpreted over one width domain."""
+
+    def __init__(self, owner: "_FileAnalysis", fn: ast.FunctionDef,
+                 scope: str) -> None:
+        self.o = owner
+        self.fn = fn
+        self.scope = scope
+        self.width = owner.width
+        self.max = (1 << self.width) - 1
+        self.env: Dict[str, object] = {}  # name -> Iv | list[Iv] | tuple
+        self.findings: List[Finding] = []
+        self.checked_ops = 0
+        self.return_iv: Optional[object] = None
+        self._suppress_reports = False
+
+    # --- reporting ---------------------------------------------------------
+
+    def _flag(self, code: str, line: int, subject: str, message: str) -> None:
+        if self._suppress_reports:
+            return
+        lines = (line, self.fn.lineno)
+        for ln in lines:
+            a = ann_mod.lookup(self.o.anns, ln)
+            if a is not None and (a.allows(code) or a.allows("absint")):
+                return
+        self.findings.append(Finding(
+            "absint", code, self.o.rel, line, self.scope, subject, message,
+        ))
+
+    # --- entry -------------------------------------------------------------
+
+    def run(self) -> None:
+        declared: Dict[str, Iv] = {}
+        a = ann_mod.lookup(self.o.anns, self.fn.lineno)
+        if a is not None and "range" in a:
+            try:
+                declared = parse_ranges(a)
+            except ValueError as e:
+                self._flag("bad-range", self.fn.lineno, "range", str(e))
+        args = self.fn.args
+        for p in (
+            list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+        ):
+            if p.arg in declared:  # declared range wins over the type hint
+                self.env[p.arg] = declared[p.arg]
+            elif (
+                isinstance(p.annotation, ast.Name)
+                and p.annotation.id == "int"
+            ):
+                # `: int`-hinted params are Python ints — arbitrary
+                # precision, exempt from machine-width checks until they
+                # pass through a machine-word constructor (np.uintNN).
+                self.env[p.arg] = HOST_TOP
+            else:
+                self.env[p.arg] = _top(self.width)
+        if args.vararg:
+            self.env[args.vararg.arg] = _top(self.width)
+        self.o.declared_ranges[self.scope] = declared
+        self._exec_block(self.fn.body)
+
+    # --- statements --------------------------------------------------------
+
+    def _exec_block(self, body) -> None:
+        for stmt in body:
+            self._exec(stmt)
+
+    def _bind(self, target, val) -> None:
+        if isinstance(target, ast.Name):
+            self.env[target.id] = val
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            vals = (
+                list(val) if isinstance(val, (list, tuple))
+                and len(val) == len(target.elts)
+                else [self._as_iv(val)] * len(target.elts)
+            )
+            for t, v in zip(target.elts, vals):
+                self._bind(t, v)
+
+    def _apply_line_range(self, stmt) -> Dict[str, Iv]:
+        a = ann_mod.lookup(self.o.anns, stmt.lineno)
+        if a is None or "range" not in a:
+            return {}
+        try:
+            return parse_ranges(a)
+        except ValueError as e:
+            self._flag("bad-range", stmt.lineno, "range", str(e))
+            return {}
+
+    def _exec(self, stmt) -> None:
+        if isinstance(stmt, ast.Assign):
+            val = self.eval(stmt.value)
+            for t in stmt.targets:
+                self._bind(t, val)
+            for name, iv in self._apply_line_range(stmt).items():
+                self.env[name] = iv  # declared assumption overrides
+        elif isinstance(stmt, ast.AugAssign):
+            cur = self.eval(stmt.target) if isinstance(stmt.target, ast.Name) \
+                else _top(self.width)
+            rhs = self.eval(stmt.value)
+            val = self._binop(stmt.op, self._as_iv(cur), self._as_iv(rhs),
+                              stmt.lineno)
+            self._bind(stmt.target, val)
+            for name, iv in self._apply_line_range(stmt).items():
+                self.env[name] = iv
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._bind(stmt.target, self.eval(stmt.value))
+        elif isinstance(stmt, ast.Return):
+            val = self.eval(stmt.value) if stmt.value is not None else Iv(0, 0)
+            self.return_iv = (
+                val if self.return_iv is None
+                else self._join_any(self.return_iv, val)
+            )
+        elif isinstance(stmt, ast.If):
+            self.eval(stmt.test)  # condition arithmetic is checked too
+            before = dict(self.env)
+            self._exec_block(stmt.body)
+            after_then = self.env
+            self.env = before
+            self._exec_block(stmt.orelse)
+            self.env = self._join_env(after_then, self.env)
+        elif isinstance(stmt, ast.For):
+            self._bind(stmt.target, self._iter_iv(stmt.iter))
+            self._fixpoint(stmt.body)
+        elif isinstance(stmt, ast.While):
+            self.eval(stmt.test)
+            self._fixpoint(stmt.body)
+        elif isinstance(stmt, ast.Expr):
+            self.eval(stmt.value)
+        elif isinstance(stmt, ast.Assert):
+            self.eval(stmt.test)
+        elif isinstance(stmt, (ast.Try,)):
+            self._exec_block(stmt.body)
+            for h in stmt.handlers:
+                self._exec_block(h.body)
+            self._exec_block(stmt.orelse)
+            self._exec_block(stmt.finalbody)
+        elif isinstance(stmt, ast.With):
+            self._exec_block(stmt.body)
+        # imports / pass / nested defs: no effect on the domain
+
+    def _iter_iv(self, it):
+        if isinstance(it, ast.Call):
+            tail = it.func.id if isinstance(it.func, ast.Name) else None
+            if tail in ("range", "reversed", "enumerate"):
+                return HOST_TOP
+        return self._as_iv(self.eval(it))
+
+    def _join_env(self, a: Dict[str, object], b: Dict[str, object]):
+        out: Dict[str, object] = {}
+        for k in set(a) | set(b):
+            if k in a and k in b:
+                out[k] = self._join_any(a[k], b[k])
+            else:
+                out[k] = a.get(k, b.get(k))
+        return out
+
+    def _join_any(self, x, y):
+        if isinstance(x, (list, tuple)) and isinstance(y, (list, tuple)) \
+                and len(x) == len(y):
+            return [self._join_any(a, b) for a, b in zip(x, y)]
+        return self._as_iv(x).join(self._as_iv(y))
+
+    def _fixpoint(self, body) -> None:
+        """Iterate a loop body until the environment stabilizes. Findings
+        are only reported on the final, post-fixpoint pass so transient
+        pre-convergence intervals cannot fire spurious rules."""
+        self._suppress_reports = True
+        saved_checked = self.checked_ops
+        for i in range(_WIDEN_AFTER):
+            before = dict(self.env)
+            self._exec_block(body)
+            self.env = self._join_env(before, self.env)
+            if all(
+                k in before and self._eq_any(before[k], self.env[k])
+                for k in self.env
+            ):
+                break
+        else:
+            # No convergence: widen every loop-touched name to TOP.
+            for k in list(self.env):
+                if not self._as_iv(self.env[k]).host:
+                    self.env[k] = _top(self.width)
+            self._exec_block(body)
+        self._suppress_reports = False
+        self.checked_ops = saved_checked
+        self._exec_block(body)  # reporting pass at the fixed point
+
+    @staticmethod
+    def _eq_any(x, y) -> bool:
+        if isinstance(x, (list, tuple)) and isinstance(y, (list, tuple)):
+            return len(x) == len(y) and all(
+                _FnAnalysis._eq_any(a, b) for a, b in zip(x, y)
+            )
+        return x == y
+
+    # --- expressions -------------------------------------------------------
+
+    def _as_iv(self, v) -> Iv:
+        if isinstance(v, Iv):
+            return v
+        if isinstance(v, (list, tuple)):
+            out: Optional[Iv] = None
+            for e in v:
+                iv = self._as_iv(e)
+                out = iv if out is None else out.join(iv)
+            return out if out is not None else Iv(0, 0)
+        return _top(self.width)
+
+    def eval(self, node) -> object:
+        if node is None:
+            return Iv(0, 0)
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, bool):
+                return BOOL
+            if isinstance(node.value, int):
+                return Iv(node.value, node.value, host=True)
+            return Iv(0, 0, host=True)
+        if isinstance(node, ast.Name):
+            if node.id in self.env:
+                return self.env[node.id]
+            if node.id in self.o.consts:
+                c = self.o.consts[node.id]
+                return Iv(c, c, host=True)
+            return _top(self.width)
+        if isinstance(node, ast.Tuple):
+            return [self.eval(e) for e in node.elts]
+        if isinstance(node, (ast.List, ast.Set)):
+            return [self.eval(e) for e in node.elts]
+        if isinstance(node, ast.Attribute):
+            if node.attr in ("shape", "dtype", "ndim", "size", "strides"):
+                return HOST_TOP
+            if node.attr in ("T",):
+                return self.eval(node.value)
+            return self._as_iv(self.eval(node.value))
+        if isinstance(node, ast.Subscript):
+            base = self.eval(node.value)
+            if isinstance(base, (list, tuple)):
+                if isinstance(node.slice, ast.Constant) and isinstance(
+                    node.slice.value, int
+                ) and 0 <= node.slice.value < len(base):
+                    return base[node.slice.value]
+                return self._as_iv(base)
+            return base
+        if isinstance(node, ast.Compare):
+            self.eval(node.left)
+            for c in node.comparators:
+                self.eval(c)
+            return BOOL
+        if isinstance(node, ast.BoolOp):
+            for v in node.values:
+                self.eval(v)
+            return BOOL
+        if isinstance(node, ast.UnaryOp):
+            v = self._as_iv(self.eval(node.operand))
+            if isinstance(node.op, ast.Invert):
+                if v == BOOL or v.hi <= 1:
+                    return BOOL
+                return _top(self.width)
+            if isinstance(node.op, ast.Not):
+                return BOOL
+            return v
+        if isinstance(node, ast.IfExp):
+            self.eval(node.test)
+            return self._join_any(self.eval(node.body), self.eval(node.orelse))
+        if isinstance(node, ast.BinOp):
+            a = self._as_iv(self.eval(node.left))
+            b = self._as_iv(self.eval(node.right))
+            return self._binop(node.op, a, b, node.lineno)
+        if isinstance(node, ast.Call):
+            return self._call(node)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            for g in node.generators:
+                self._bind(g.target, self._iter_iv(g.iter))
+                for cond in g.ifs:
+                    self.eval(cond)
+            return self._as_iv(self.eval(node.elt))
+        if isinstance(node, ast.Starred):
+            return self.eval(node.value)
+        return _top(self.width)
+
+    # --- arithmetic with width checks --------------------------------------
+
+    def _binop(self, op, a: Iv, b: Iv, line: int) -> Iv:
+        host = a.host and b.host
+        if isinstance(op, ast.Add):
+            if not host:
+                self.checked_ops += 1
+                if a.hi + b.hi > self.max:
+                    self._flag(
+                        "limb-overflow", line, "+",
+                        f"add may exceed {self.width}-bit limb width "
+                        f"([{a.lo},{a.hi}] + [{b.lo},{b.hi}])",
+                    )
+                    return _top(self.width)
+            return Iv(a.lo + b.lo, a.hi + b.hi, host)
+        if isinstance(op, ast.Sub):
+            if not host:
+                self.checked_ops += 1
+                if a.lo - b.hi < 0:
+                    self._flag(
+                        "limb-underflow", line, "-",
+                        f"subtract may underflow "
+                        f"([{a.lo},{a.hi}] - [{b.lo},{b.hi}])",
+                    )
+                    return _top(self.width)
+            return Iv(max(a.lo - b.hi, 0) if not host else a.lo - b.hi,
+                      max(a.hi - b.lo, 0) if not host else a.hi - b.lo, host)
+        if isinstance(op, ast.Mult):
+            if not host:
+                self.checked_ops += 1
+                if a.hi * b.hi > self.max:
+                    self._flag(
+                        "limb-overflow", line, "*",
+                        f"multiply may exceed {self.width}-bit limb width "
+                        f"([{a.lo},{a.hi}] * [{b.lo},{b.hi}])",
+                    )
+                    return _top(self.width)
+            return Iv(a.lo * b.lo, a.hi * b.hi, host)
+        if isinstance(op, ast.LShift):
+            if not host:
+                self.checked_ops += 1
+                if b.hi > 1 << 16 or (a.hi << min(b.hi, 1 << 16)) > self.max:
+                    self._flag(
+                        "limb-overflow", line, "<<",
+                        f"left shift may exceed {self.width}-bit limb width "
+                        f"([{a.lo},{a.hi}] << [{b.lo},{b.hi}])",
+                    )
+                    return _top(self.width)
+            return Iv(a.lo << b.lo, a.hi << min(b.hi, 1 << 16), host)
+        if isinstance(op, ast.RShift):
+            return Iv(a.lo >> min(b.hi, 1 << 16), a.hi >> b.lo, host)
+        if isinstance(op, ast.BitAnd):
+            return Iv(0, min(a.hi, b.hi), host)
+        if isinstance(op, (ast.BitOr, ast.BitXor)):
+            return _bitlen_bound(a, b)
+        if isinstance(op, ast.FloorDiv):
+            return Iv(a.lo // max(b.hi, 1), a.hi // max(b.lo, 1), host)
+        if isinstance(op, ast.Mod):
+            return Iv(0, max(b.hi - 1, 0), host)
+        if isinstance(op, ast.Pow) and host:
+            return Iv(a.lo ** b.lo, a.hi ** b.hi, host=True)
+        return _top(self.width)
+
+    # --- calls -------------------------------------------------------------
+
+    _CONST_CTORS = frozenset(("uint32", "uint64", "int32", "int64", "uint8",
+                              "uint16", "int8", "int16"))
+    _HULL_CALLS = frozenset(("where", "select", "stack", "concatenate",
+                             "minimum", "maximum", "broadcast_to", "clip",
+                             "sort", "unique", "reshape", "tile", "asarray"))
+
+    def _call(self, node: ast.Call) -> object:
+        func = node.func
+        tail = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None
+        )
+        # Method chains: x.astype(...), x.reshape(...), .at[].add/set.
+        if isinstance(func, ast.Attribute):
+            if tail == "append" and isinstance(func.value, ast.Name):
+                # Accumulator lists are modeled as a single hull element
+                # (length-stable, so loop fixpoints converge).
+                name = func.value.id
+                iv = self._as_iv(self.eval(node.args[0])) if node.args \
+                    else Iv(0, 0)
+                cur = self.env.get(name)
+                if isinstance(cur, list):
+                    hull = iv if not cur else self._as_iv(cur).join(iv)
+                    self.env[name] = [hull]
+                return Iv(0, 0, host=True)
+            if tail == "astype":
+                base = self._as_iv(self.eval(func.value))
+                if node.args and "bool" in ast.dump(node.args[0]):
+                    return BOOL
+                return base
+            if tail in ("reshape", "copy", "flatten", "ravel"):
+                return self.eval(func.value)
+            if tail in ("add", "set", "subtract", "mul", "min", "max"):
+                recv = func.value
+                if isinstance(recv, ast.Subscript) and isinstance(
+                    recv.value, ast.Attribute
+                ) and recv.value.attr == "at":
+                    base = self._as_iv(self.eval(recv.value.value))
+                    argv = self._as_iv(
+                        self.eval(node.args[0]) if node.args else Iv(0, 0)
+                    )
+                    if tail == "set":
+                        return base.join(argv)
+                    # Unbounded accumulation: TOP unless a line `range=`
+                    # annotation (applied by the Assign handler) narrows
+                    # the bound — the annotation carries the count proof.
+                    return _top(self.width)
+        args = [self.eval(a) for a in node.args]
+        if tail in self._CONST_CTORS:
+            # Machine-word constructor: the value leaves Python-int land
+            # and wraps at the word width from here on.
+            if not args:
+                return Iv(0, 0)
+            iv = self._as_iv(args[0])
+            if iv.hi > self.max:
+                iv = _top(self.width)
+            return Iv(iv.lo, iv.hi)
+        if tail in ("zeros", "zeros_like", "empty"):
+            return Iv(0, 0)
+        if tail in ("ones", "ones_like"):
+            if any("bool" in ast.dump(kw.value) for kw in node.keywords):
+                return BOOL
+            return Iv(1, 1)
+        if tail == "full":
+            return self._as_iv(args[1]) if len(args) > 1 else _top(self.width)
+        if tail in ("where", "select"):
+            if len(args) >= 3:
+                return self._join_any(args[1], args[2])
+            return self._as_iv(args[-1]) if args else _top(self.width)
+        if tail in ("minimum", "min_"):
+            if len(args) == 2:
+                a, b = self._as_iv(args[0]), self._as_iv(args[1])
+                return Iv(min(a.lo, b.lo), min(a.hi, b.hi), a.host and b.host)
+        if tail == "maximum" and len(args) == 2:
+            a, b = self._as_iv(args[0]), self._as_iv(args[1])
+            return Iv(max(a.lo, b.lo), max(a.hi, b.hi), a.host and b.host)
+        if tail == "clip" and len(args) >= 3:
+            v, lo, hi = (self._as_iv(x) for x in args[:3])
+            return Iv(max(v.lo, lo.lo), min(v.hi, hi.hi))
+        if tail in self._HULL_CALLS:
+            return self._as_iv(args) if args else _top(self.width)
+        if tail == "bit_length":
+            return Iv(0, 256, host=True)
+        if tail == "int":
+            # Materializes to a Python int: arbitrary precision again.
+            iv = self._as_iv(args) if args else HOST_TOP
+            return Iv(iv.lo, iv.hi, host=True)
+        if tail in ("len", "sum", "min", "max", "abs"):
+            if tail == "len":
+                return HOST_TOP
+            return self._as_iv(args) if args else HOST_TOP
+        if tail in ("range", "reversed", "enumerate", "arange"):
+            return HOST_TOP
+        if tail in ("broadcast_shapes",):
+            return HOST_TOP
+        # Local function: summary + declared-range obligations.
+        if isinstance(func, ast.Name) and func.id in self.o.functions:
+            return self._local_call(func.id, node, args)
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and tail in self.o.functions
+        ):
+            return self._local_call(tail, node, args)
+        return _top(self.width)
+
+    def _local_call(self, name: str, node: ast.Call, args) -> object:
+        summary = self.o.summary(name)
+        fn = self.o.functions[name]
+        params = [p.arg for p in fn.args.args]
+        declared = self.o.declared_ranges.get(name, {})
+        for pname, arg_iv in zip(params, args):
+            d = declared.get(pname)
+            if d is None:
+                continue
+            iv = self._as_iv(arg_iv)
+            if iv.host:
+                continue
+            self.checked_ops += 1
+            if iv.hi > d.hi or iv.lo < d.lo:
+                self._flag(
+                    "range-obligation", node.lineno, f"{name}.{pname}",
+                    f"argument [{iv.lo},{iv.hi}] may exceed {name}()'s "
+                    f"declared range {pname}:[{d.lo},{d.hi}]",
+                )
+        return summary if summary is not None else _top(self.width)
+
+
+class _FileAnalysis:
+    def __init__(self, path: pathlib.Path, root: pathlib.Path,
+                 width: int) -> None:
+        self.width = width
+        source = path.read_text()
+        self.rel = path.resolve().relative_to(root.resolve()).as_posix()
+        self.anns = ann_mod.collect(source)
+        self.tree = ast.parse(source)
+        self.functions: Dict[str, ast.FunctionDef] = {
+            n.name: n for n in self.tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        # Module constants, folded with Python (arbitrary-precision) ints.
+        self.consts: Dict[str, int] = {}
+        for n in self.tree.body:
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Name):
+                v = _const_fold(n.value)
+                if v is not None:
+                    self.consts[n.targets[0].id] = v
+        self.declared_ranges: Dict[str, Dict[str, Iv]] = {}
+        self._summaries: Dict[str, object] = {}
+        self._in_progress: set = set()
+        self.findings: List[Finding] = []
+        self.checked_ops = 0
+
+    def summary(self, name: str):
+        """Return-interval summary of a local function analyzed at its
+        declared entry ranges (memoized; None on recursion)."""
+        if name in self._summaries:
+            return self._summaries[name]
+        if name in self._in_progress:
+            return None
+        self._in_progress.add(name)
+        fa = _FnAnalysis(self, self.functions[name], name)
+        fa._suppress_reports = True  # findings come from the main pass
+        fa.run()
+        self._in_progress.discard(name)
+        self._summaries[name] = fa.return_iv
+        return fa.return_iv
+
+    def run(self) -> Tuple[List[Finding], int]:
+        # Pre-pass: register every function's declared ranges (call-site
+        # obligations need them regardless of analysis order).
+        for name, fn in self.functions.items():
+            a = ann_mod.lookup(self.anns, fn.lineno)
+            declared: Dict[str, Iv] = {}
+            if a is not None and "range" in a:
+                try:
+                    declared = parse_ranges(a)
+                except ValueError:
+                    pass  # reported by the function's own analysis below
+            self.declared_ranges[name] = declared
+        for name, fn in self.functions.items():
+            fa = _FnAnalysis(self, fn, name)
+            fa.run()
+            self.findings.extend(fa.findings)
+            self.checked_ops += fa.checked_ops
+        self.findings.sort(key=lambda f: (f.file, f.line, f.code))
+        return self.findings, self.checked_ops
+
+
+def _const_fold(node) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.BinOp):
+        a, b = _const_fold(node.left), _const_fold(node.right)
+        if a is None or b is None:
+            return None
+        try:
+            if isinstance(node.op, ast.Add):
+                return a + b
+            if isinstance(node.op, ast.Sub):
+                return a - b
+            if isinstance(node.op, ast.Mult):
+                return a * b
+            if isinstance(node.op, ast.LShift):
+                return a << b
+            if isinstance(node.op, ast.RShift):
+                return a >> b
+            if isinstance(node.op, ast.BitOr):
+                return a | b
+            if isinstance(node.op, ast.BitAnd):
+                return a & b
+            if isinstance(node.op, ast.BitXor):
+                return a ^ b
+        except (OverflowError, ValueError):
+            return None
+    if isinstance(node, ast.Call) and node.args:
+        # np.uint64(CONST)-style constant wrappers.
+        tail = node.func.attr if isinstance(node.func, ast.Attribute) else (
+            node.func.id if isinstance(node.func, ast.Name) else None
+        )
+        if tail in ("uint8", "uint16", "uint32", "uint64",
+                    "int8", "int16", "int32", "int64"):
+            return _const_fold(node.args[0])
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = _const_fold(node.operand)
+        return -v if v is not None else None
+    return None
+
+
+def prove_file(path, root, width: int) -> Tuple[List[Finding], int]:
+    """(findings, checked arithmetic-op count) for one file."""
+    return _FileAnalysis(pathlib.Path(path), pathlib.Path(root), width).run()
+
+
+def analyze_file(path, root, width: int) -> List[Finding]:
+    return prove_file(path, root, width)[0]
+
+
+def run(root) -> List[Finding]:
+    root = pathlib.Path(root)
+    findings: List[Finding] = []
+    for rel, width in manifest.ABSINT_TARGETS.items():
+        path = root / rel
+        if path.exists():
+            findings.extend(analyze_file(path, root, width))
+    findings.sort(key=lambda f: (f.file, f.line, f.code))
+    return findings

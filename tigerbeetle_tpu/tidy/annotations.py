@@ -28,9 +28,13 @@ PREFIX = "tidy:"
 
 # Keys the passes understand. `allow` values name a rule code (or a pass
 # name) being waived on that line; everything else declares structure.
-KNOWN_KEYS = frozenset(
-    ("owner", "guarded-by", "atomic", "thread", "holds", "allow", "barrier", "init")
-)
+# `static` (jaxlint) names parameters that are trace-time constants (the
+# special value `return` declares the function's RESULT static); `range`
+# (absint) declares entry intervals: `range=name:lo..hi,other:lo..hi`.
+KNOWN_KEYS = frozenset((
+    "owner", "guarded-by", "atomic", "thread", "holds", "allow", "barrier",
+    "init", "static", "range",
+))
 
 
 class LineAnnotations:

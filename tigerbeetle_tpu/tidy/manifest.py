@@ -78,6 +78,65 @@ DETERMINISM_INCLUDE = (
 )
 DETERMINISM_EXCLUDE = ("tigerbeetle_tpu/vsr/clock.py",)
 
+# --- jaxlint: device hot-path lint scope ---------------------------------
+
+# Modules the host-sync / retrace / reduction passes analyze: the jitted
+# kernels themselves (ops/, parallel/) and the host dispatcher that calls
+# them (models/state_machine.py). Like the ownership pass, scope is a
+# declaration — cross-module call edges resolve only within this set.
+JAXLINT_MODULES = (
+    "tigerbeetle_tpu/ops/commit.py",
+    "tigerbeetle_tpu/ops/commit_exact.py",
+    "tigerbeetle_tpu/ops/merge.py",
+    "tigerbeetle_tpu/models/state_machine.py",
+    "tigerbeetle_tpu/parallel/sharding.py",
+    "tigerbeetle_tpu/parallel/sharded_ops.py",
+)
+
+# Jit entry points (by callable tail name) → their static argnames. A
+# call site passing a batch-dependent value in a static position is a
+# retrace per value; a device value returned by one of these is a sync
+# when materialized (bool/int/float/np.asarray/.item).
+JIT_ENTRIES = {
+    "create_transfers_fast": (),
+    "create_transfers_exact": ("max_sweeps", "has_pv", "has_chains"),
+    "register_accounts": (),
+    "write_balances": (),
+    "read_balances": (),
+    "merge_kernel": (),
+    "merge_kernel_tiled": ("tile",),
+}
+
+# (repo-relative file, qualified function) pairs forming the SANCTIONED
+# dispatch/finish seam: the only host-side places allowed to materialize
+# device values (device→host sync) or block_until_ready. Everything else
+# must stay async — a sync elsewhere silently serializes the overlapped
+# pipeline (docs/COMMIT_PIPELINE.md split-phase dispatch).
+JAXLINT_SYNC_SEAM = frozenset((
+    ("tigerbeetle_tpu/models/state_machine.py", "StateMachine._commit_fast_device"),
+    ("tigerbeetle_tpu/models/state_machine.py", "StateMachine.create_transfers_finish"),
+    ("tigerbeetle_tpu/models/state_machine.py", "StateMachine._create_transfers_exact"),
+    ("tigerbeetle_tpu/models/state_machine.py", "StateMachine._read_balances"),
+    ("tigerbeetle_tpu/ops/merge.py", "merge_device"),
+))
+
+# Functions whose results count as shape-stabilized (bucket-padded):
+# jit-entry arguments produced by these escape the retrace-shape rule.
+JAXLINT_PAD_HELPERS = frozenset((
+    "_device_batch", "_pad_pow2", "_pad_slots", "pad1", "p1",
+))
+
+# --- absint: limb-width abstract interpretation scope --------------------
+
+# file → limb width in bits. Every +, -, *, << in these files must be
+# PROVEN to stay within the width from annotated entry ranges (`range=`),
+# or carry an inline `allow=` with the reason (intentional wrap carry
+# tricks).
+ABSINT_TARGETS = {
+    "tigerbeetle_tpu/ops/u128.py": 32,
+    "tigerbeetle_tpu/lsm/scan.py": 64,
+}
+
 # --- marker scan scope ---------------------------------------------------
 
 # Directories / top-level scripts covered by the banned-marker scan.

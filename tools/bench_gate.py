@@ -2,16 +2,22 @@
 latest recorded round benchmark (BENCH_r*.json) and fail on a >10%
 regression in the e2e metrics (accepted throughput, client-perceived
 p50) or the LSM store metrics (config5 ingest / major-compaction rates).
+Steady-state jit compile counts (`steady_compiles`, recorded per device
+workload by bench.py via the tidy compile registry) are gated EXACTLY:
+any drift from the baselined value means a retrace crept into the hot
+path, which fails the gate the same way a >10% perf drop does.
 
 Usage:
     python bench.py | tee /tmp/bench.json
     python tools/bench_gate.py /tmp/bench.json         # file with the JSON line
     python bench.py | python tools/bench_gate.py -     # stdin
     python tools/bench_gate.py --current-json '<json>' # inline
+    python tools/bench_gate.py --list                  # gated metrics + thresholds
 
-Exit codes: 0 pass, 1 regression, 2 usage/missing-data. Every gate run
-appends a record to devhub.jsonl so the pass/fail history rides the same
-series as the bench numbers (reference devhub.zig:36-52).
+Exit codes: 0 pass, 1 regression, 2 usage/missing-data (no baseline
+recorded, no parsable bench output). Every gate run appends a record to
+devhub.jsonl so the pass/fail history rides the same series as the
+bench numbers (reference devhub.zig:36-52).
 
 The e2e bar this repo is chasing (ROADMAP.md open items): end_to_end
 load_accepted_tx_per_s ≥ 1,000,000 and perceived_p50_ms ≤ 10 — the gate
@@ -45,6 +51,15 @@ GATED = (
     ("end_to_end", "perceived_p99_ms", False),
     ("config5_lsm", "ingest_rows_per_s", True),
     ("config5_lsm", "major_compaction_rows_per_s", True),
+)
+
+GATED_EXACT = (
+    # (section, key): must EQUAL the baselined value. Steady-state jit
+    # compile counts per device workload — zero in a healthy run; any
+    # nonzero delta means a retrace regression (shape/dtype instability
+    # or a leaked Python-scalar capture) on the measured path.
+    ("config1_default", "steady_compiles"),
+    ("config2_zipf", "steady_compiles"),
 )
 
 
@@ -95,7 +110,25 @@ def main(argv=None) -> int:
                    help="bench JSON passed inline instead of a file")
     p.add_argument("--devhub", default=os.path.join(REPO, "devhub.jsonl"),
                    help="series file to append the gate record to")
+    p.add_argument("--list", action="store_true",
+                   help="print the gated metrics and current thresholds, then exit")
     args = p.parse_args(argv)
+
+    if args.list:
+        rnd, baseline = latest_round_extra()
+        src = f"BENCH_r{rnd:02d}.json" if baseline is not None else "(no baseline)"
+        print(f"gated metrics (baseline: {src}):")
+        for section, key, higher in GATED:
+            base = (baseline or {}).get(section, {}).get(key)
+            rule = ("≥ baseline × 0.90" if higher else "≤ baseline × 1.10")
+            base_s = f"{float(base):,.1f}" if base is not None else "—"
+            print(f"  {section}.{key:32s} {rule:22s} baseline={base_s}")
+        for section, key in GATED_EXACT:
+            base = (baseline or {}).get(section, {}).get(key)
+            base_s = f"{base}" if base is not None else "—"
+            print(f"  {section}.{key:32s} {'== baseline (exact)':22s} "
+                  f"baseline={base_s}")
+        return 0
 
     if args.current_json is not None:
         text = args.current_json
@@ -106,27 +139,41 @@ def main(argv=None) -> int:
             text = f.read()
     current = extract_extra(text)
     if current is None:
-        print("bench_gate: no end_to_end block in the input", file=sys.stderr)
+        print(
+            "bench_gate: no end_to_end block found in the input — expected "
+            "bench.py's JSON output line (run `python bench.py | python "
+            "tools/bench_gate.py -`)", file=sys.stderr,
+        )
         return 2
     rnd, baseline = latest_round_extra()
     if baseline is None:
-        print("bench_gate: no BENCH_r*.json baseline found — recording only")
+        print(
+            f"bench_gate: no BENCH_r*.json baseline found under {REPO} — "
+            "nothing to gate against. Record one first (save bench.py's "
+            "JSON output as BENCH_r<NN>.json) or run --list to see the "
+            "gated metrics.", file=sys.stderr,
+        )
+        return 2
 
     failed = []
     rows = []
     for section, key, higher_better in GATED:
         cur_sec = current.get(section) or {}
-        base_sec = (baseline.get(section) or {}) if baseline else {}
+        base_sec = baseline.get(section) or {}
         label = f"{section}.{key}"
         if key not in cur_sec:
             # A section the current run skipped/errored FAILS the gate
             # whenever the baseline recorded it (a crashed bench must
-            # not pass as "no regression"); with no baseline either,
-            # there is nothing to compare (n/a).
+            # not pass as "no regression"); when the baseline never
+            # recorded it either, there is nothing to compare (n/a).
             base = float(base_sec[key]) if key in base_sec else None
             if base is not None:
                 failed.append(label)
-            rows.append((label, None, base, "MISSING" if base is not None else "n/a"))
+            rows.append((
+                label, None, base,
+                "MISSING (section absent from current run)"
+                if base is not None else "n/a",
+            ))
             continue
         cur = float(cur_sec[key])
         base = float(base_sec[key]) if key in base_sec else None
@@ -142,6 +189,28 @@ def main(argv=None) -> int:
             if not ok:
                 failed.append(label)
         rows.append((label, cur, base, verdict))
+
+    for section, key in GATED_EXACT:
+        cur_sec = current.get(section) or {}
+        base_sec = baseline.get(section) or {}
+        label = f"{section}.{key}"
+        base = base_sec.get(key)
+        cur = cur_sec.get(key)
+        if base is None:
+            rows.append((label, cur, None, "n/a"))
+            continue
+        if cur is None:
+            failed.append(label)
+            rows.append((label, None, float(base),
+                         "MISSING (section absent from current run)"))
+            continue
+        ok = int(cur) == int(base)
+        if not ok:
+            failed.append(label)
+        rows.append((
+            label, float(cur), float(base),
+            "ok" if ok else "COMPILE-COUNT DRIFT (retrace regression)",
+        ))
 
     width = max(len(k) for k, *_ in rows)
     print(f"bench gate vs BENCH_r{rnd:02d}.json (>10% regression fails):")
@@ -161,14 +230,12 @@ def main(argv=None) -> int:
                 "baseline_round": rnd,
                 "current": {
                     f"{s}.{k}": (current.get(s) or {}).get(k)
-                    for s, k, _ in GATED
+                    for s, k in [(s, k) for s, k, _ in GATED] + list(GATED_EXACT)
                 },
-                "baseline": (
-                    {
-                        f"{s}.{k}": (baseline.get(s) or {}).get(k)
-                        for s, k, _ in GATED
-                    } if baseline else None
-                ),
+                "baseline": {
+                    f"{s}.{k}": (baseline.get(s) or {}).get(k)
+                    for s, k in [(s, k) for s, k, _ in GATED] + list(GATED_EXACT)
+                },
                 "failed": failed,
             },
         })

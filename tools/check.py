@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Single static-analysis entry: every tidy pass, one report, one baseline.
+
+Runs the full analyzer suite — ownership/lockset, determinism lint,
+marker scan, and the device hot-path passes (host-sync, retrace,
+reduction, absint) — against the repo and gates on the shared baseline
+(tigerbeetle_tpu/tidy/baseline.json). CI and tier-1 call exactly this
+(tests/test_tidy.py::test_repo_has_no_new_findings runs the same
+check()); tools/tidy_check.py remains as a thin alias.
+
+    python tools/check.py                  # human report, exit 1 on new findings
+    python tools/check.py --json           # machine-readable
+    python tools/check.py --passes host-sync retrace absint
+    python tools/check.py --write-baseline # accept current findings
+    python tools/check.py --strict-stale   # rotted baseline entries fail too
+
+Annotation syntax and the suppression workflow: docs/STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+
+def _pass_names():
+    from tigerbeetle_tpu import tidy
+
+    return tidy.all_pass_names()
+
+
+def check(root=None, passes=None, baseline_file=None) -> dict:
+    """Run passes + baseline split; returns the full report dict (the
+    pytest entry and --json consume this directly)."""
+    from tigerbeetle_tpu import tidy
+    from tigerbeetle_tpu.tidy.findings import load_baseline, split_by_baseline
+
+    root = pathlib.Path(root) if root is not None else REPO
+    findings = tidy.run_passes(root, passes)
+    baseline = load_baseline(baseline_file)
+    new, suppressed, stale = split_by_baseline(findings, baseline)
+    return {
+        "root": str(root),
+        "passes": list(passes) if passes is not None else list(_pass_names()),
+        "findings": [f.to_dict() for f in findings],
+        "new": [f.to_dict() for f in new],
+        "suppressed": [f.to_dict() for f in suppressed],
+        "stale_baseline_keys": stale,
+        "ok": not new,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("root", nargs="?", default=None,
+                    help="repo root (default: this checkout)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument(
+        "--passes", nargs="+", choices=tuple(_pass_names()),
+        default=None, help="subset of passes (default: all)",
+    )
+    ap.add_argument("--baseline", default=None, help="baseline file override")
+    ap.add_argument(
+        "--write-baseline", action="store_true",
+        help="accept every current finding into the baseline and exit 0",
+    )
+    ap.add_argument(
+        "--strict-stale", action="store_true",
+        help="also fail when the baseline contains entries nothing produces",
+    )
+    args = ap.parse_args(argv)
+
+    if args.write_baseline:
+        # One sweep: accept the current findings without the (redundant)
+        # baseline-split report.
+        from tigerbeetle_tpu import tidy
+        from tigerbeetle_tpu.tidy.findings import write_baseline
+
+        findings = tidy.run_passes(
+            pathlib.Path(args.root) if args.root else REPO, args.passes
+        )
+        write_baseline(findings, args.baseline)
+        print(f"baseline: {len(findings)} finding(s) accepted")
+        return 0
+
+    report = check(args.root, args.passes, args.baseline)
+
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        for f in report["new"]:
+            print(f"NEW  {f['file']}:{f['line']}: [{f['pass']}/{f['code']}] "
+                  f"{f['scope']}: {f['message']}")
+        for f in report["suppressed"]:
+            print(f"base {f['file']}:{f['line']}: [{f['pass']}/{f['code']}] "
+                  f"{f['scope']}: {f['subject']}")
+        for k in report["stale_baseline_keys"]:
+            print(f"stale baseline entry: {k}")
+        print(
+            f"check: {len(report['new'])} new, {len(report['suppressed'])} "
+            f"baselined, {len(report['stale_baseline_keys'])} stale "
+            f"(passes: {', '.join(report['passes'])})"
+        )
+    if report["new"]:
+        return 1
+    if args.strict_stale and report["stale_baseline_keys"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,107 +1,29 @@
 #!/usr/bin/env python
-"""Run the tidy static passes against the repo and gate on the baseline.
+"""Thin alias for tools/check.py (the historical tidy entry point).
 
-Exit status 0 when every finding is either inline-suppressed or covered
-by the checked-in baseline (tigerbeetle_tpu/tidy/baseline.json), 1 when
-new findings exist (or --strict-stale and the baseline has rotted
-entries). The workflow mirrors bench_gate: run locally before pushing,
-wire into CI via the pytest entry (tests/test_tidy.py runs the same
-function), consume `--json` from automation.
-
-    python tools/tidy_check.py                 # human report
-    python tools/tidy_check.py --json          # machine-readable
-    python tools/tidy_check.py --passes ownership determinism
-    python tools/tidy_check.py --write-baseline  # accept current findings
-
-Annotation syntax and the suppression workflow: docs/STATIC_ANALYSIS.md.
+tools/check.py is the single static-analysis entry now — it runs every
+pass (ownership, determinism, markers, host-sync, retrace, reduction,
+absint) with one --json report and one baseline. This shim keeps the
+`python tools/tidy_check.py` spelling (and its importable check()/
+main()) working for scripts and docs that grew up with it.
 """
 
 from __future__ import annotations
 
-import argparse
-import json
+import importlib.util
 import pathlib
 import sys
 
-REPO = pathlib.Path(__file__).resolve().parents[1]
+_TOOLS = pathlib.Path(__file__).resolve().parent
+REPO = _TOOLS.parent
 sys.path.insert(0, str(REPO))
 
+_spec = importlib.util.spec_from_file_location("tools_check", _TOOLS / "check.py")
+_check_mod = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_check_mod)
 
-def check(root=None, passes=None, baseline_file=None) -> dict:
-    """Run passes + baseline split; returns the full report dict (the
-    pytest entry and --json consume this directly)."""
-    from tigerbeetle_tpu import tidy
-    from tigerbeetle_tpu.tidy.findings import load_baseline, split_by_baseline
-
-    root = pathlib.Path(root) if root is not None else REPO
-    findings = tidy.run_passes(root, passes)
-    baseline = load_baseline(baseline_file)
-    new, suppressed, stale = split_by_baseline(findings, baseline)
-    return {
-        "root": str(root),
-        "passes": passes or ["ownership", "determinism", "markers"],
-        "findings": [f.to_dict() for f in findings],
-        "new": [f.to_dict() for f in new],
-        "suppressed": [f.to_dict() for f in suppressed],
-        "stale_baseline_keys": stale,
-        "ok": not new,
-    }
-
-
-def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("root", nargs="?", default=None, help="repo root (default: this checkout)")
-    ap.add_argument("--json", action="store_true", help="machine-readable report on stdout")
-    ap.add_argument(
-        "--passes", nargs="+", choices=("ownership", "determinism", "markers"),
-        default=None, help="subset of passes (default: all)",
-    )
-    ap.add_argument("--baseline", default=None, help="baseline file override")
-    ap.add_argument(
-        "--write-baseline", action="store_true",
-        help="accept every current finding into the baseline and exit 0",
-    )
-    ap.add_argument(
-        "--strict-stale", action="store_true",
-        help="also fail when the baseline contains entries nothing produces",
-    )
-    args = ap.parse_args(argv)
-
-    report = check(args.root, args.passes, args.baseline)
-
-    if args.write_baseline:
-        from tigerbeetle_tpu import tidy
-        from tigerbeetle_tpu.tidy.findings import write_baseline
-
-        findings = tidy.run_passes(
-            pathlib.Path(args.root) if args.root else REPO, args.passes
-        )
-        write_baseline(findings, args.baseline)
-        print(f"baseline: {len(findings)} finding(s) accepted")
-        return 0
-
-    if args.json:
-        print(json.dumps(report, indent=2))
-    else:
-        for f in report["new"]:
-            print(f"NEW  {f['file']}:{f['line']}: [{f['pass']}/{f['code']}] "
-                  f"{f['scope']}: {f['message']}")
-        for f in report["suppressed"]:
-            print(f"base {f['file']}:{f['line']}: [{f['pass']}/{f['code']}] "
-                  f"{f['scope']}: {f['subject']}")
-        for k in report["stale_baseline_keys"]:
-            print(f"stale baseline entry: {k}")
-        print(
-            f"tidy: {len(report['new'])} new, {len(report['suppressed'])} "
-            f"baselined, {len(report['stale_baseline_keys'])} stale "
-            f"(passes: {', '.join(report['passes'])})"
-        )
-    if report["new"]:
-        return 1
-    if args.strict_stale and report["stale_baseline_keys"]:
-        return 1
-    return 0
-
+check = _check_mod.check
+main = _check_mod.main
 
 if __name__ == "__main__":
     sys.exit(main())
