@@ -668,6 +668,17 @@ def bench_e2e():
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
         out = {}
+        # Primary path: the driver's machine-readable BENCH_JSON line
+        # carries every percentile PLUS the server-side lifecycle
+        # decomposition (queue_wait_*/service_*/occupancy_* — scraped
+        # from /lifecycle). The regex scrape of the human lines below is
+        # kept only as a fallback for older drivers / partial output.
+        for line in proc.stdout.splitlines():
+            if line.startswith("BENCH_JSON "):
+                try:
+                    out.update(json.loads(line[len("BENCH_JSON "):]))
+                except json.JSONDecodeError:
+                    pass
         pats = {
             "load_accepted_tx_per_s": r"load accepted = ([\d,]+) tx/s",
             "batch_p50_ms": r"batch latency p50 = ([\d.]+) ms",
@@ -680,6 +691,8 @@ def bench_e2e():
         }
         for line in proc.stdout.splitlines():
             for key, pat in pats.items():
+                if key in out:
+                    continue
                 m = re.match(pat, line)
                 if m:
                     out[key] = float(m.group(1).replace(",", ""))

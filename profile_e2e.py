@@ -340,6 +340,57 @@ def main(backend="numpy", batches=40, overlap=True, store_async=True,
         for k, ms in stalls.items():
             print(f"  {k:22s} {ms / batches:9.2f} ms/batch")
 
+    # Per-op lifecycle: the queue-wait vs service decomposition from the
+    # registry — where each prepare's latency actually lives, per stage,
+    # with Little's-law occupancy (mean prepares resident per stage).
+    lifecycle = tracer.lifecycle_summary()
+    comps = lifecycle["components"]
+    if comps:
+        print(f"\nper-op lifecycle decomposition ({lifecycle['ops']} ops, "
+              f"window {lifecycle['window_s']:.2f}s):")
+        print(f"  {'component':18s} {'ms/op':>9s} {'p50_ms':>9s} "
+              f"{'p99_ms':>9s} {'occupancy':>10s}")
+        window_sum = 0.0
+        for name, s in comps.items():
+            occ = lifecycle["occupancy"].get(name, 0.0)
+            print(f"  {name:18s} {s['mean_ms']:9.3f} {s['p50_ms']:9.3f} "
+                  f"{s['p99_ms']:9.3f} {occ:10.2f}")
+            if ".store" not in name:
+                window_sum += s["mean_ms"]
+        perceived = lifecycle["perceived"]
+        if perceived.get("count"):
+            print(f"  {'= perceived':18s} {perceived['mean_ms']:9.3f} "
+                  f"{perceived['p50_ms']:9.3f} {perceived['p99_ms']:9.3f} "
+                  f"{lifecycle['occupancy'].get('total', 0.0):10.2f}")
+            # Acceptance invariant: the window components TILE the
+            # arrive→reply interval, so their means must sum to the mean
+            # perceived latency (within 10% — clamped negatives on
+            # cross-thread hand-offs are the only slack).
+            drift = abs(window_sum - perceived["mean_ms"])
+            assert drift <= 0.10 * perceived["mean_ms"], (
+                f"lifecycle decomposition ({window_sum:.3f} ms) does not "
+                f"sum to perceived ({perceived['mean_ms']:.3f} ms)"
+            )
+
+    # Device-step profiler: per-jit-entry device time + transfer bytes
+    # (numpy backend never dispatches, so the table is jax-only).
+    dev_rows = {
+        k: v for k, v in snap.items()
+        if k.startswith("device.") and v.get("total_ms")
+    }
+    if dev_rows:
+        print("\ndevice steps (per jit entry; step = dispatch->finish):")
+        print(f"  {'entry':34s} {'calls':>7s} {'ms/call':>9s} "
+              f"{'p50_us':>9s} {'p99_us':>9s}")
+        for k in sorted(dev_rows):
+            r = dev_rows[k]
+            print(f"  {k:34s} {r['count']:7d} "
+                  f"{r['total_ms'] / max(r['count'], 1):9.3f} "
+                  f"{r.get('p50_us', 0.0):9.1f} {r.get('p99_us', 0.0):9.1f}")
+        h2d = snap.get("device.h2d_bytes", {}).get("count", 0)
+        d2h = snap.get("device.d2h_bytes", {}).get("count", 0)
+        print(f"  transfers: h2d {h2d / 1e6:.1f} MB, d2h {d2h / 1e6:.1f} MB")
+
     trace_path = tracer.dump(
         os.environ.get("TIGERBEETLE_TPU_TRACE_FILE",
                        os.path.join(tmp, "trace_e2e.json"))
@@ -357,6 +408,7 @@ def main(backend="numpy", batches=40, overlap=True, store_async=True,
                 "backend": backend, "batches": batches,
                 "overlap": overlap, "store_async": store_async,
                 "stages": record,
+                "lifecycle": lifecycle["flat"],
             },
         },
     )

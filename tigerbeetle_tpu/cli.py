@@ -298,10 +298,43 @@ def _repl_execute(client, op: str, rest: str, types) -> None:
         print(f"unknown operation: {op}")
 
 
+def _http_get_json(port: int, path: str, timeout: float = 10.0):
+    """Minimal HTTP GET against the replica's observability endpoint
+    (tracer.serve_metrics): the benchmark driver scrapes /lifecycle for
+    the server-side queue/service decomposition — no client library."""
+    import json
+    import socket
+
+    with socket.create_connection(("127.0.0.1", port), timeout=timeout) as s:
+        s.settimeout(timeout)
+        s.sendall(
+            f"GET {path} HTTP/1.1\r\nHost: bench\r\nConnection: close\r\n\r\n".encode()
+        )
+        buf = b""
+        while True:
+            chunk = s.recv(1 << 16)
+            if not chunk:
+                break
+            buf += chunk
+    head, _, body = buf.partition(b"\r\n\r\n")
+    if not head.startswith(b"HTTP/1.1 200"):
+        # head may be EMPTY (connection closed before any bytes): no
+        # indexing — this error must stay inside the caller's
+        # (OSError, ValueError) fallback, never crash the benchmark.
+        raise IOError(f"scrape {path}: {head[:64]!r}")
+    return json.loads(body)
+
+
 def cmd_benchmark(args) -> int:
     """Spawn a temp single-replica cluster and run the load (reference
     benchmark_driver.zig + benchmark_load.zig). For the pure device-kernel
-    number see bench.py at the repo root."""
+    number see bench.py at the repo root.
+
+    Emits one machine-readable `BENCH_JSON {...}` line with every
+    percentile plus the server's per-op queue-wait/service decomposition
+    and pipeline occupancy (scraped from /lifecycle) — bench.py parses
+    that line; its regex over the human output is only a fallback."""
+    import json
     import os
     import subprocess
     import tempfile
@@ -312,6 +345,15 @@ def cmd_benchmark(args) -> int:
     from tigerbeetle_tpu.client import Client
 
     port = args.port
+    # The metrics endpoint implies tracing in the server — the lifecycle
+    # decomposition exists only there (enabled-tracing overhead is <2% of
+    # batch time, microbenched in tests/test_lifecycle.py; inside the
+    # gate's 10% margin). --untraced runs the server without it for an
+    # overhead A/B or an apples-to-apples rerun of a pre-lifecycle
+    # baseline.
+    mport = 0 if args.untraced else (
+        args.metrics_port if args.metrics_port else port + 1
+    )
     with tempfile.TemporaryDirectory() as tmp:
         path = os.path.join(tmp, "bench.tigerbeetle")
         rc = cmd_format(argparse.Namespace(
@@ -323,6 +365,8 @@ def cmd_benchmark(args) -> int:
             f"--addresses=127.0.0.1:{port}", "--replica=0",
             f"--config={args.config}", f"--backend={args.backend}",
         ]
+        if mport:
+            server_args.append(f"--metrics-port={mport}")
         if args.serial_commit:
             server_args.append("--serial-commit")
         if args.serial_store:
@@ -332,7 +376,11 @@ def cmd_benchmark(args) -> int:
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
         )
         try:
-            proc.stdout.readline()  # wait for "listening"
+            # Wait for the listener announcement (the metrics line may
+            # print first).
+            for _ in range(8):
+                if b"listening" in proc.stdout.readline():
+                    break
             client = Client([("127.0.0.1", port)])
             batch = min(args.batch, 8190)
 
@@ -434,6 +482,15 @@ def cmd_benchmark(args) -> int:
                 return sorted_vals[min(len(sorted_vals) - 1,
                                        int(len(sorted_vals) * q))]
 
+            result = {
+                "load_accepted_tx_per_s": round(sent / dt, 1),
+                "batch_p50_ms": round(pct(lat, 0.5) * 1e3, 3),
+                "batch_p90_ms": round(pct(lat, 0.9) * 1e3, 3),
+                "batch_p99_ms": round(pct(lat, 0.99) * 1e3, 3),
+                "perceived_p50_ms": round(pct(perceived, 0.5) * 1e3, 3),
+                "perceived_p90_ms": round(pct(perceived, 0.9) * 1e3, 3),
+                "perceived_p99_ms": round(pct(perceived, 0.99) * 1e3, 3),
+            }
             print(f"load accepted = {sent / dt:,.0f} tx/s")
             print(f"batch latency p50 = {pct(lat, 0.5) * 1e3:.2f} ms")
             print(f"batch latency p90 = {pct(lat, 0.9) * 1e3:.2f} ms")
@@ -446,6 +503,18 @@ def cmd_benchmark(args) -> int:
             print(f"client-perceived p90 = {pct(perceived, 0.9) * 1e3:.2f} ms")
             print(f"client-perceived p99 = {pct(perceived, 0.99) * 1e3:.2f} ms")
 
+            # Server-side lifecycle decomposition: per-stage queue-wait
+            # vs service p50/p99 and pipeline occupancy, scraped BEFORE
+            # the query phase so it covers exactly the transfer load.
+            if mport:
+                try:
+                    lc = _http_get_json(mport, "/lifecycle")
+                    result.update(lc.get("flat", {}))
+                    result["lifecycle_ops"] = lc.get("ops", 0)
+                    result["flight_dumps"] = lc.get("flight", {}).get("dumps", 0)
+                except (OSError, ValueError) as e:
+                    print(f"lifecycle scrape failed: {e}", file=sys.stderr)
+
             # Query phase (reference benchmark_load.zig: account queries
             # after the load; prints query latency p90).
             if args.queries:
@@ -456,7 +525,12 @@ def cmd_benchmark(args) -> int:
                     client.get_account_transfers(aid, limit=100)
                     qlat.append(time.perf_counter() - q0)
                 qlat.sort()
-                print(f"query latency p90 = {qlat[int(len(qlat) * 0.9)] * 1e3:.2f} ms")
+                q90 = qlat[int(len(qlat) * 0.9)]
+                result["query_p90_ms"] = round(q90 * 1e3, 3)
+                print(f"query latency p90 = {q90 * 1e3:.2f} ms")
+            # The machine-readable result line (bench.py parses this;
+            # the regex over the human lines above is only a fallback).
+            print("BENCH_JSON " + json.dumps(result), flush=True)
         finally:
             proc.terminate()
             try:
@@ -571,6 +645,13 @@ def main(argv=None) -> int:
     b.add_argument("--rate", type=int, default=1_000_000)
     b.add_argument("--config", default="production")
     b.add_argument("--backend", default="jax", choices=["jax", "numpy"])
+    b.add_argument("--metrics-port", type=int, default=0,
+                   help="server observability port for the lifecycle "
+                        "scrape (default: --port + 1)")
+    b.add_argument("--untraced", action="store_true",
+                   help="run the server without tracing/metrics (no "
+                        "lifecycle decomposition) — overhead A/B or "
+                        "pre-lifecycle baseline comparison")
     b.add_argument("--serial-commit", action="store_true",
                    help="run the server with the overlapped commit stage "
                         "disabled (A/B comparison)")

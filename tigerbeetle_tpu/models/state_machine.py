@@ -119,6 +119,15 @@ def _codes_to_results(codes: np.ndarray) -> np.ndarray:
     return out
 
 
+def _staged_nbytes(batch, host_code) -> int:
+    """Host→device byte volume of a staged kernel call (the device-step
+    profiler's h2d counter). Shape metadata only — `.nbytes` never
+    materializes a device value."""
+    return sum(getattr(a, "nbytes", 0) for a in batch) + getattr(
+        host_code, "nbytes", 0
+    )
+
+
 def _batch_has_dup(events: np.ndarray) -> bool:
     """Any duplicate transfer id within the batch? C hash probe when the
     shim is available (~10× the lexsort-adjacency check), else numpy."""
@@ -575,11 +584,18 @@ class StateMachine:
             slots_p, = self._pad_slots(
                 [np.asarray(slots, dtype=np.int32)], k, [0]
             )
-            dp, dpo, cp, cpo = self._ops.read_balances(self.state, slots_p)
-            return (
-                np.asarray(dp)[:k], np.asarray(dpo)[:k],
-                np.asarray(cp)[:k], np.asarray(cpo)[:k],
+            with tracer.device_step("read_balances"):
+                dp, dpo, cp, cpo = self._ops.read_balances(self.state, slots_p)
+                # Materialize the FULL padded arrays first: the sliced
+                # views undercount the actual device→host volume.
+                full = (
+                    np.asarray(dp), np.asarray(dpo),
+                    np.asarray(cp), np.asarray(cpo),
+                )
+            tracer.device_bytes(
+                h2d=slots_p.nbytes, d2h=sum(a.nbytes for a in full)
             )
+            return tuple(a[:k] for a in full)
         s = np.asarray(slots, dtype=np.int64)
         hb = self._host_bal
         return (
@@ -596,8 +612,12 @@ class StateMachine:
                 [np.asarray(slots, dtype=np.int32), dp, dpo, cp, cpo],
                 k, [oob, 0, 0, 0, 0],
             )
-            self.state = self._ops.write_balances(
-                self.state, slots_p, dp_p, dpo_p, cp_p, cpo_p
+            with tracer.device_step("write_balances"):
+                self.state = self._ops.write_balances(
+                    self.state, slots_p, dp_p, dpo_p, cp_p, cpo_p
+                )
+            tracer.device_bytes(
+                h2d=_staged_nbytes((slots_p, dp_p, dpo_p, cp_p), cpo_p)
             )
         else:
             s = np.asarray(slots, dtype=np.int64)
@@ -620,8 +640,12 @@ class StateMachine:
                 ],
                 k, [-1, 0, 0, False],
             )
-            self.state = self._ops.register_accounts(
-                self.state, slots_p, ledger_p, flags_p, mask_p
+            with tracer.device_step("register_accounts"):
+                self.state = self._ops.register_accounts(
+                    self.state, slots_p, ledger_p, flags_p, mask_p
+                )
+            tracer.device_bytes(
+                h2d=_staged_nbytes((slots_p, ledger_p, flags_p), mask_p)
             )
 
     # ------------------------------------------------------------------
@@ -929,16 +953,24 @@ class StateMachine:
         bail to serial on overflow, store OK rows."""
         n = len(events)
         b, host_code_p = self._device_batch(events, ts, dr_slots, cr_slots, host_code)
+        t_disp = tracer.device_dispatch(
+            "create_transfers_fast", h2d_bytes=_staged_nbytes(b, host_code_p)
+        )
         with tracer.span("sm.create_transfers.fast"):
             new_state, codes_dev, bail = self._ops.create_transfers_fast(
                 self.state, b, host_code_p
             )
         if bool(bail):
+            # The bail sync ends the device step: close the window here
+            # or the dispatch/step counters diverge on bail-heavy loads.
+            tracer.device_finish("create_transfers_fast", t_disp)
             self.stats["bail_batches"] += 1
             return self._create_transfers_serial(events, timestamp)
         self.state = new_state
         self.stats["fast_batches"] += 1
-        codes = np.asarray(codes_dev)[:n]
+        codes_h = np.asarray(codes_dev)
+        tracer.device_finish("create_transfers_fast", t_disp, d2h_bytes=codes_h.nbytes)
+        codes = codes_h[:n]
 
         ok = codes == 0
         if np.any(ok):
@@ -1020,6 +1052,14 @@ class StateMachine:
             "codes": codes_dev, "bail": bail_dev,
             "prev_state": self.state, "gen": self._state_gen,
             "id_lo": events["id_lo"],
+            # Device-step profiler: dispatch timestamp; finish closes the
+            # dispatch→finish window — device time isolated from the host
+            # work between the two calls. (No materialization here: this
+            # function is deliberately OUTSIDE the jaxlint sync seam.)
+            "t_disp": tracer.device_dispatch(
+                "create_transfers_fast",
+                h2d_bytes=_staged_nbytes(b, host_code_p),
+            ),
         }
         # Chain optimistically: batch N+1's kernel may consume this token
         # before N's sync lands (the device orders the data dependency).
@@ -1043,16 +1083,23 @@ class StateMachine:
             # mutates state that any LATER outstanding handle's kernel
             # did not observe, so fence those too (they will refire in
             # turn at their own finish).
+            tracer.device_finish("create_transfers_fast", handle.get("t_disp", 0))
             self._state_gen += 1
             return self._create_transfers_impl(events, timestamp)
         if bool(handle["bail"]):
+            tracer.device_finish("create_transfers_fast", handle.get("t_disp", 0))
             self.state = handle["prev_state"]
             self._state_gen += 1
             self.stats["bail_batches"] += 1
             return self._create_transfers_serial(events, timestamp)
         self.stats["fast_batches"] += 1
         ts = handle["ts"]
-        codes = np.asarray(handle["codes"])[:n]
+        codes_h = np.asarray(handle["codes"])
+        tracer.device_finish(
+            "create_transfers_fast", handle.get("t_disp", 0),
+            d2h_bytes=codes_h.nbytes,
+        )
+        codes = codes_h[:n]
         ok = codes == 0
         if np.any(ok):
             if ok.all():
@@ -1072,6 +1119,7 @@ class StateMachine:
         if not self._ct_pending or handle is not self._ct_pending[-1]:
             return
         self._ct_pending.pop()
+        tracer.device_finish("create_transfers_fast", handle.get("t_disp", 0))
         if handle["gen"] == self._state_gen:
             # A stale gen means an earlier bail already rolled the token
             # back past this handle's base — restoring would clobber it.

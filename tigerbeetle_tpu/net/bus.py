@@ -216,11 +216,14 @@ class ReplicaServer:
         try:
             with tracer.span("bus.dispatch"):
                 self.replica.on_message(msg)
-        except Exception:
+        except Exception as e:
             log.error(
                 "replica raised during on_message — failing stop:\n%s",
                 traceback.format_exc(),
             )
+            # Flight recorder: dump the op records leading up to the
+            # crash before the server stops — post-hoc causality.
+            tracer.flight_exception(f"on_message: {e!r}")
             self.stop()
             raise
 
@@ -262,11 +265,12 @@ class ReplicaServer:
         def _guarded(cb) -> None:
             try:
                 cb()
-            except Exception:
+            except Exception as e:
                 log.error(
                     "replica raised in a pipeline-stage callback — "
                     "failing stop:\n%s", traceback.format_exc(),
                 )
+                tracer.flight_exception(f"stage callback: {e!r}")
                 self.stop()
                 raise
 
@@ -363,6 +367,18 @@ class ReplicaServer:
                 conn.send(Message(pong).seal().to_bytes())
                 continue  # hello is transport-level, not for the replica
             if cmd == Command.REQUEST:
+                if h["client"] != 0 and tracer.enabled() and self.replica.is_primary:
+                    # Lifecycle arrival stamp: the op's perceived window
+                    # opens HERE, at the bus — request-queue wait (the
+                    # dominant term of the ROADMAP's 225 ms perceived
+                    # p50) is measured from the wire, not from prepare.
+                    # Primary only: a backup just forwards the request,
+                    # and claiming a record per forwarded message would
+                    # be steady per-request allocation for nothing (the
+                    # forwarded copy re-arrives on the primary's bus and
+                    # opens its window there).
+                    msg.lifecycle = tracer.op_begin()
+                    tracer.op_stamp(msg.lifecycle, tracer.OP_ARRIVE)
                 # Map only direct client connections: a REQUEST arriving on
                 # an identified peer connection was *forwarded* by a backup
                 # and must not steal the client's reply route.

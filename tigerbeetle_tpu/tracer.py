@@ -27,6 +27,22 @@ loop and their stall/idle time decides throughput:
     benchmark time series. Here: `devhub_append(path, record)` appends
     one JSON line stamped with the wall clock AND the current git
     revision, so every `devhub.jsonl` row is attributable to a commit.
+  - Per-OPERATION lifecycle records (the reference tracer.zig's typed
+    replica_commit/checkpoint span lifecycles, not thread aggregates):
+    each prepare carries one pooled `OpRecord` stamped at every
+    pipeline hand-off (bus arrival, request-queue, prepare, WAL queue
+    vs write, quorum, commit-queue vs execute, reply, store-queue vs
+    store), yielding an exact queue-wait vs service decomposition per
+    stage — `lifecycle_summary()` reports p50/p99 per component plus
+    Little's-law pipeline occupancy. The last N completed records form
+    the FLIGHT RECORDER ring, dumped (JSON + Perfetto) when an anomaly
+    trips: perceived latency beyond a multiple of the running p99, a
+    stage stall beyond threshold, or a pipeline exception.
+  - Device-step profiler: per-jit-entry device execution time
+    (dispatch→finish, isolating device time from host time) and
+    h2d/d2h transfer byte counters, entry names validated against the
+    jaxlint JIT_ENTRIES manifest so kernel work is always attributable
+    to a manifest-declared entry point.
 
 Thread model: every recording path (span/count/observe) writes only
 thread-local state created lazily per thread and registered for merge;
@@ -42,13 +58,17 @@ allocates nothing.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
 from array import array
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from tigerbeetle_tpu.tidy import runtime as tidy_runtime
+
+log = logging.getLogger("tigerbeetle_tpu.tracer")
 
 _enabled = os.environ.get("TIGERBEETLE_TPU_TRACE", "") not in ("", "0")
 
@@ -221,6 +241,14 @@ def reset() -> None:
         _generation += 1
         _states.clear()
         _gauges.clear()
+        # Lifecycle state re-arms with the spans: ring, pool, running
+        # perceived histogram, summary window, and the dump budget.
+        _op_ring.clear()
+        _op_pool.clear()
+        _op_hist[:] = array("q", _HIST_ZEROS)
+        _op_window[0] = _op_window[1] = _op_window[2] = 0
+        _flight["dumps"] = 0
+        _flight["last_dump_ns"] = 0
 
 
 def configure(ring_size: Optional[int] = None) -> None:
@@ -293,6 +321,573 @@ def remove_gauge(name: str) -> None:
 def gauges() -> Dict[str, float]:
     with _registry_lock:
         return dict(_gauges)
+
+
+# --- per-operation lifecycle (queue-wait vs service decomposition) ------
+#
+# One pooled OpRecord per prepare, stamped at every pipeline hand-off.
+# The stamps are plain perf_counter_ns writes into a preallocated array
+# slot; each stamp index is written by exactly one thread at a known
+# hand-off point, and the record travels WITH the op (message attribute /
+# job dict), so stamp writes are ordered by the same queue hand-offs that
+# order the op itself — no locking on the stamp path. Finalization
+# (op_finish, loop thread) observes the derived components into the
+# ordinary span histograms and files the record in the flight ring.
+
+# Stamp indices. Components telescope: the window components (request →
+# reply) tile [ARRIVE, REPLY] exactly, so their means sum to the mean
+# server-perceived latency by construction. Store components trail the
+# reply (the async store stage runs behind it) and are reported
+# separately.
+(
+    OP_ARRIVE, OP_PREPARE, OP_WAL_ENQUEUE, OP_WAL_WRITE, OP_WAL_DURABLE,
+    OP_COMMIT_SUBMIT, OP_EXEC_START, OP_EXEC_END, OP_REPLY,
+    OP_STORE_SUBMIT, OP_STORE_START, OP_STORE_END,
+) = range(12)
+OP_STAMPS = 12
+OP_STAMP_NAMES = (
+    "arrive", "prepare", "wal_enqueue", "wal_write", "wal_durable",
+    "commit_submit", "exec_start", "exec_end", "reply",
+    "store_submit", "store_start", "store_end",
+)
+
+# (event, from-stamp, to-stamp): the arrive→reply window decomposition.
+OP_COMPONENTS = (
+    ("op.queue.request", OP_ARRIVE, OP_PREPARE),
+    ("op.service.prepare", OP_PREPARE, OP_WAL_ENQUEUE),
+    ("op.queue.wal", OP_WAL_ENQUEUE, OP_WAL_WRITE),
+    ("op.service.wal", OP_WAL_WRITE, OP_WAL_DURABLE),
+    ("op.queue.quorum", OP_WAL_DURABLE, OP_COMMIT_SUBMIT),
+    ("op.queue.commit", OP_COMMIT_SUBMIT, OP_EXEC_START),
+    ("op.service.execute", OP_EXEC_START, OP_EXEC_END),
+    ("op.service.reply", OP_EXEC_END, OP_REPLY),
+)
+# Store components trail the reply; excluded from the perceived window.
+OP_STORE_COMPONENTS = (
+    ("op.queue.store", OP_STORE_SUBMIT, OP_STORE_START),
+    ("op.service.store", OP_STORE_START, OP_STORE_END),
+)
+_OP_ZEROS = bytes(8 * OP_STAMPS)
+
+
+class OpRecord:
+    """One prepare's lifecycle: identity + stamp array. Pooled — reset()
+    zeroes in place, no per-op allocation at steady state."""
+
+    __slots__ = (
+        "op", "client", "request", "operation", "n_events", "t", "done",
+        "released",
+    )
+
+    def __init__(self) -> None:
+        self.t = array("q", _OP_ZEROS)
+        self.reset()
+
+    def reset(self) -> None:
+        self.op = 0
+        self.client = 0
+        self.request = 0
+        self.operation = 0
+        self.n_events = 0
+        self.done = False
+        # Set by op_store_done: no thread holds the record any longer,
+        # so an eviction may recycle it (see op_finish). Fault-dropped
+        # records are never released and fall to the GC instead.
+        self.released = False
+        t = self.t
+        for i in range(OP_STAMPS):
+            t[i] = 0
+
+
+OP_RING_DEFAULT = 128  # completed records retained for the flight dump
+
+# Clamped ≥ 1: FLIGHT_OPS=0 must degrade to a one-record ring, never an
+# empty-deque pop on the first completed op.
+_op_ring_size = max(
+    1, int(os.environ.get("TIGERBEETLE_TPU_FLIGHT_OPS", OP_RING_DEFAULT))
+)
+_op_ring: deque = deque()  # tidy: guarded-by=_registry_lock
+_op_pool: List[OpRecord] = []  # tidy: guarded-by=_registry_lock
+# Running histogram of server-perceived latency (arrive→reply) — the
+# anomaly detector's "running p99" source; independent of the per-thread
+# arenas so reset generations cannot skew the trip threshold mid-window.
+_op_hist = array("q", _HIST_ZEROS)  # tidy: guarded-by=_registry_lock
+# [first_finalize_ns, last_finalize_ns, perceived_count]: the summary
+# window for Little's-law occupancy.
+_op_window = [0, 0, 0]  # tidy: guarded-by=_registry_lock
+
+# Flight-recorder policy. latency_mult: trip when perceived latency
+# exceeds mult × running p99; stall_ns: trip when any single component
+# exceeds this; min_ops: samples required before the latency rule arms;
+# max_dumps/cooldown_ns: disk-spam bounds.
+_flight = {  # tidy: guarded-by=_registry_lock
+    "latency_mult": float(os.environ.get("TIGERBEETLE_TPU_FLIGHT_MULT", 8.0)),
+    "stall_ns": int(
+        float(os.environ.get("TIGERBEETLE_TPU_FLIGHT_STALL_MS", 2000.0)) * 1e6
+    ),
+    "min_ops": 64,
+    "max_dumps": 3,
+    "cooldown_ns": 5_000_000_000,
+    "dir": os.environ.get("TIGERBEETLE_TPU_FLIGHT_DIR", ""),
+    "dumps": 0,
+    "last_dump_ns": 0,
+}
+
+
+def op_begin() -> Optional[OpRecord]:
+    """Claim a pooled lifecycle record (None when tracing is disabled —
+    every op_* accessor below accepts None and returns immediately, so
+    the disabled path stays allocation-free)."""
+    if not _enabled:
+        return None
+    with _registry_lock:
+        rec = _op_pool.pop() if _op_pool else None
+    if rec is None:
+        return OpRecord()
+    rec.reset()
+    return rec
+
+
+def op_stamp(rec: Optional[OpRecord], idx: int, t_ns: Optional[int] = None) -> None:
+    """Record one hand-off stamp (now, or an injected t_ns for scripted
+    tests). Overwrites: a requeued op (grid repair) re-stamps, so the
+    decomposition reflects the final successful pass."""
+    if rec is None:
+        return
+    rec.t[idx] = time.perf_counter_ns() if t_ns is None else t_ns
+
+
+def op_stamp_first(rec: Optional[OpRecord], idx: int) -> None:
+    """Stamp only if unset — the double-buffered device path marks
+    exec-start at dispatch; the settle path must not overwrite it."""
+    if rec is None or rec.t[idx]:
+        return
+    rec.t[idx] = time.perf_counter_ns()
+
+
+def op_clear(rec: Optional[OpRecord], *indices: int) -> None:
+    """Unset stamps on a requeued op (grid-repair reclaim): the retry
+    re-stamps through op_stamp_first, so the decomposition reflects the
+    final successful pass, not the faulted one."""
+    if rec is None:
+        return
+    for i in indices:
+        rec.t[i] = 0
+
+
+def op_meta(rec: Optional[OpRecord], op: int = 0, client: int = 0,
+            request: int = 0, operation: int = 0, n_events: int = 0) -> None:
+    if rec is None:
+        return
+    rec.op = op
+    rec.client = client
+    rec.request = request
+    rec.operation = operation
+    rec.n_events = n_events
+
+
+def _op_components(rec: OpRecord, table) -> List[tuple]:
+    """[(event, duration_ns)] for components whose BOTH stamps landed.
+    Negative spans (cross-thread clock skew or out-of-order hand-offs on
+    multi-replica quorums) clamp to 0 — the histograms need v >= 0."""
+    t = rec.t
+    out = []
+    for event, a, b in table:
+        ta, tb = t[a], t[b]
+        if ta and tb:
+            out.append((event, tb - ta if tb > ta else 0))
+    return out
+
+
+def op_finish(rec: Optional[OpRecord]) -> None:
+    """Finalize the arrive→reply window: observe every component and the
+    totals into the registry histograms, file the record in the flight
+    ring, and run the anomaly checks. Called once per op on the loop
+    thread (completion application); idempotent via rec.done. Store
+    components land later via op_store_done — the record is already in
+    the ring and the store thread fills its stamps in place."""
+    if rec is None or rec.done:
+        return
+    rec.done = True
+    comps = _op_components(rec, OP_COMPONENTS)
+    queue_total = 0
+    service_total = 0
+    worst = ("", 0)
+    for event, d in comps:
+        observe(event, d)
+        if ".queue." in event:
+            queue_total += d
+        else:
+            service_total += d
+        if d > worst[1]:
+            worst = (event, d)
+    t = rec.t
+    perceived = t[OP_REPLY] - t[OP_ARRIVE] if t[OP_REPLY] and t[OP_ARRIVE] else 0
+    if perceived > 0:
+        # Totals only for FULL arrive→reply records: a journal-path
+        # commit (backup/catch-up — execute+store stamps only) would
+        # otherwise dilute the gated queue_wait/service_total
+        # distributions toward its missing components.
+        observe("op.queue.total", queue_total)
+        observe("op.service.total", service_total)
+    trip = None
+    with _registry_lock:
+        now = time.perf_counter_ns()
+        if not _op_window[0]:
+            _op_window[0] = now
+        _op_window[1] = now
+        if len(_op_ring) >= _op_ring_size:
+            evicted = _op_ring.popleft()
+            # Recycle only records no thread can still stamp: RELEASED
+            # (store phase fully reported — op_store_done ran; a
+            # backpressured store backlog may trail arbitrarily) AND
+            # WAL-complete (a quorum can commit before the local WAL
+            # entry leaves the writer queue, which holds the record
+            # until its durable stamp lands). Anything else falls to
+            # the GC — a trailing stamp into a reset record would
+            # corrupt a fresh op.
+            et = evicted.t
+            if evicted.released and (
+                not et[OP_WAL_ENQUEUE] or et[OP_WAL_DURABLE]
+            ):
+                _op_pool.append(evicted)
+        _op_ring.append(rec)
+        if perceived > 0:
+            if _op_window[2] >= _flight["min_ops"]:
+                p99 = _hist_percentile(_op_hist, _op_window[2], 0.99)
+                if p99 > 0 and perceived > _flight["latency_mult"] * p99:
+                    trip = (
+                        f"latency: perceived {perceived / 1e6:.1f} ms > "
+                        f"{_flight['latency_mult']:g}x running p99 "
+                        f"{p99 / 1e6:.1f} ms (op {rec.op})"
+                    )
+            _op_hist[bucket_index(perceived)] += 1
+            _op_window[2] += 1
+        if trip is None and worst[1] > _flight["stall_ns"]:
+            trip = (
+                f"stall: {worst[0]} {worst[1] / 1e6:.1f} ms > "
+                f"{_flight['stall_ns'] / 1e6:.0f} ms threshold (op {rec.op})"
+            )
+    if perceived > 0:
+        observe("op.perceived", perceived)
+    if trip is not None:
+        flight_trip(trip)
+
+
+def op_store_done(rec: Optional[OpRecord]) -> None:
+    """Observe the trailing store components (store thread, after the
+    op's reply is long gone) and run the stall check on them."""
+    if rec is None:
+        return
+    worst = ("", 0)
+    for event, d in _op_components(rec, OP_STORE_COMPONENTS):
+        observe(event, d)
+        if d > worst[1]:
+            worst = (event, d)
+    with _registry_lock:
+        stall_ns = _flight["stall_ns"]
+    if worst[1] > stall_ns:
+        flight_trip(
+            f"stall: {worst[0]} {worst[1] / 1e6:.1f} ms > "
+            f"{stall_ns / 1e6:.0f} ms threshold (op {rec.op})"
+        )
+    # Last touch of the record: eviction may now recycle it.
+    rec.released = True
+
+
+def op_record_dict(rec: OpRecord) -> dict:
+    """JSON-ready view of one lifecycle record: raw stamps share the
+    perf_counter timebase with trace_events(), so a flight dump and its
+    companion Perfetto trace align op-for-op."""
+    t = rec.t
+    comps = {
+        e: round(d / 1e6, 3)
+        for e, d in _op_components(rec, OP_COMPONENTS + OP_STORE_COMPONENTS)
+    }
+    out = {
+        "op": rec.op, "client": rec.client, "request": rec.request,
+        "operation": rec.operation, "n_events": rec.n_events,
+        "stamps": {
+            OP_STAMP_NAMES[i]: t[i] for i in range(OP_STAMPS) if t[i]
+        },
+        "components": comps,
+    }
+    if t[OP_REPLY] and t[OP_ARRIVE]:
+        out["perceived_ms"] = round((t[OP_REPLY] - t[OP_ARRIVE]) / 1e6, 3)
+    return out
+
+
+def flight_records() -> List[dict]:
+    """The completed-op ring as JSON-ready dicts (newest last).
+    Serialized UNDER the lock: an eviction may recycle (reset + restamp)
+    a record concurrently, and a dict mixing two ops' fields would
+    corrupt exactly the post-hoc artifact this ring exists for."""
+    with _registry_lock:
+        return [op_record_dict(r) for r in _op_ring]
+
+
+def configure_flight(
+    latency_mult: Optional[float] = None,
+    stall_ms: Optional[float] = None,
+    min_ops: Optional[int] = None,
+    max_dumps: Optional[int] = None,
+    cooldown_s: Optional[float] = None,
+    directory: Optional[str] = None,
+    ring: Optional[int] = None,
+) -> None:
+    """Adjust flight-recorder policy; ring resizes (and clears) the
+    completed-op ring."""
+    global _op_ring_size
+    with _registry_lock:
+        if latency_mult is not None:
+            _flight["latency_mult"] = float(latency_mult)
+        if stall_ms is not None:
+            _flight["stall_ns"] = int(stall_ms * 1e6)
+        if min_ops is not None:
+            _flight["min_ops"] = int(min_ops)
+        if max_dumps is not None:
+            _flight["max_dumps"] = int(max_dumps)
+        if cooldown_s is not None:
+            _flight["cooldown_ns"] = int(cooldown_s * 1e9)
+        if directory is not None:
+            _flight["dir"] = directory
+        if ring is not None:
+            _op_ring_size = max(1, int(ring))
+            _op_ring.clear()
+            _op_pool.clear()
+
+
+def flight_trip(reason: str) -> Optional[str]:
+    """Dump the flight recorder (op records as JSON + the span rings as
+    a Perfetto trace) for post-hoc causality on a tail anomaly. Rate
+    limited (max_dumps per process + cooldown) so a pathological run
+    cannot spam the disk. Returns the dump path, or None when
+    suppressed."""
+    if not _enabled:
+        return None
+    with _registry_lock:
+        now = time.perf_counter_ns()
+        if _flight["dumps"] >= _flight["max_dumps"]:
+            return None
+        if now - _flight["last_dump_ns"] < _flight["cooldown_ns"] and _flight["dumps"]:
+            return None
+        _flight["dumps"] += 1
+        seq = _flight["dumps"]
+        _flight["last_dump_ns"] = now
+        # Serialize under the lock (see flight_records): a concurrent
+        # evict-and-recycle must not mix two ops into one dump record.
+        recs = [op_record_dict(r) for r in _op_ring]
+        directory = _flight["dir"]
+    if not directory:
+        import tempfile
+
+        directory = tempfile.gettempdir()
+    base = os.path.join(directory, f"tbtpu_flight_{os.getpid()}_{seq}")
+    doc = {
+        "reason": reason,
+        "tripped_ns": now,
+        "ops": recs,
+    }
+    try:
+        with open(base + ".json", "w") as f:
+            json.dump(doc, f)
+        with open(base + "_trace.json", "w") as f:
+            json.dump(export_trace(), f)
+    except OSError:
+        return None  # read-only disk must not take the pipeline down
+    count("mark.flight_dump")
+    log.warning(
+        "flight recorder tripped (%s) — dumped %d op records to %s.json "
+        "(+ Perfetto %s_trace.json; waterfall: python tools/trace_summary.py "
+        "--ops %s.json)", reason, len(doc["ops"]), base, base, base,
+    )
+    return base + ".json"
+
+
+def flight_exception(reason: str) -> Optional[str]:
+    """Pipeline-exception trip (stage poison / fail-stop dispatch): dump
+    unconditionally of the latency rules — the causal window before a
+    crash is exactly what the recorder exists for."""
+    return flight_trip(f"exception: {reason}")
+
+
+_OCCUPANCY_STAGES = {  # tidy: atomic — immutable constant table, never written after import
+    "wal": ("op.queue.wal", "op.service.wal"),
+    "execute": ("op.queue.commit", "op.service.execute"),
+    "store": ("op.queue.store", "op.service.store"),
+    "total": ("op.perceived",),
+}
+
+
+def _op_window_ns() -> int:
+    with _registry_lock:
+        return max(0, _op_window[1] - _op_window[0])
+
+
+def _stage_occupancy(total_ms_of, window_ns: int) -> Dict[str, float]:
+    """Little's-law stage occupancy from per-event total milliseconds
+    (shared by lifecycle_summary and the /metrics gauges — the scrape
+    reuses its own snapshot instead of paying a second merge)."""
+    if window_ns <= 0:
+        return {}
+    return {
+        stage: round(sum(total_ms_of(e) for e in events) * 1e6 / window_ns, 3)
+        for stage, events in _OCCUPANCY_STAGES.items()
+    }
+
+
+def lifecycle_summary() -> dict:
+    """The per-op decomposition from the registry: per-component
+    count/mean/p50/p99 (ms), the server-perceived window, Little's-law
+    pipeline occupancy (component total time / summary window — mean
+    prepares resident per stage), and flight-recorder status. `flat`
+    holds the benchmark-facing key set (queue_wait_*/service_*/
+    occupancy_*) that bench.py records and tools/bench_gate.py gates."""
+    agg, hists, _counters = _merged()
+    with _registry_lock:
+        first, last, _n = _op_window
+        flight = {
+            "dumps": _flight["dumps"], "ring": len(_op_ring),
+            "latency_mult": _flight["latency_mult"],
+            "stall_ms": round(_flight["stall_ns"] / 1e6, 1),
+        }
+    window_ns = max(0, last - first)
+    components: Dict[str, dict] = {}
+    flat: Dict[str, float] = {}
+    occupancy: Dict[str, float] = {}
+
+    def stats(event):
+        rec = agg.get(event)
+        if rec is None:
+            return None
+        n, total, _mx = rec
+        h = hists.get(event)
+        hn = sum(h) if h else 0
+        return {
+            "count": n,
+            "mean_ms": round(total / n / 1e6, 4) if n else 0.0,
+            "total_ms": round(total / 1e6, 3),
+            "p50_ms": round(_hist_percentile(h, hn, 0.50) / 1e6, 4) if h else 0.0,
+            "p99_ms": round(_hist_percentile(h, hn, 0.99) / 1e6, 4) if h else 0.0,
+        }
+
+    for event, _a, _b in OP_COMPONENTS + OP_STORE_COMPONENTS:
+        s = stats(event)
+        if s is None:
+            continue
+        short = event[len("op."):]
+        components[short] = s
+        key = short.replace("queue.", "queue_wait_").replace("service.", "service_")
+        flat[f"{key}_ms"] = s["mean_ms"]
+        flat[f"{key}_p50_ms"] = s["p50_ms"]
+        flat[f"{key}_p99_ms"] = s["p99_ms"]
+        if window_ns > 0:
+            occupancy[short] = round(s["total_ms"] * 1e6 / window_ns, 3)
+    for event, key in (
+        ("op.queue.total", "queue_wait_total"),
+        ("op.service.total", "service_total"),
+        ("op.perceived", "lifecycle_perceived"),
+    ):
+        s = stats(event)
+        if s is None:
+            continue
+        flat[f"{key}_ms"] = s["mean_ms"]
+        flat[f"{key}_p50_ms"] = s["p50_ms"]
+        flat[f"{key}_p99_ms"] = s["p99_ms"]
+    # Stage occupancy: mean prepares resident per pipeline stage (wait +
+    # service of that stage), plus the whole arrive→reply window.
+    occupancy.update(_stage_occupancy(
+        lambda e: agg[e][1] / 1e6 if e in agg else 0.0, window_ns
+    ))
+    for k in ("wal", "execute", "store", "total"):
+        if k in occupancy:
+            flat[f"occupancy_{k}"] = occupancy[k]
+    perceived = stats("op.perceived") or {"count": 0}
+    return {
+        "ops": perceived["count"],
+        "window_s": round(window_ns / 1e9, 3),
+        "components": components,
+        "perceived": perceived,
+        "occupancy": occupancy,
+        "flight": flight,
+        "flat": flat,
+    }
+
+
+# --- device-step profiler -----------------------------------------------
+#
+# Per-jit-entry device execution time and transfer byte counters, keyed
+# by the jaxlint JIT_ENTRIES manifest: an entry name this module has
+# never heard of raises, so every device kernel's numbers stay
+# attributable to a manifest-declared entry point (the same contract the
+# retrace pass enforces on the call sites).
+
+_device_entries_extra: set = set()  # tidy: guarded-by=_registry_lock
+
+
+def register_device_entry(name: str) -> None:
+    """Admit a runtime-built jit entry (mesh/sharded kernels) to the
+    device-step namespace."""
+    with _registry_lock:
+        _device_entries_extra.add(name)
+
+
+def _device_entry_check(entry: str) -> None:
+    from tigerbeetle_tpu.tidy import manifest
+
+    if entry in manifest.JIT_ENTRIES:
+        return
+    with _registry_lock:
+        known = entry in _device_entries_extra
+    if not known:
+        raise ValueError(
+            f"unknown device entry {entry!r}: add it to "
+            "tidy/manifest.JIT_ENTRIES (or register_device_entry) so its "
+            "kernel numbers stay attributable"
+        )
+
+
+def device_step(entry: str):
+    """Span over a BLOCKING jit entry (call + materialization):
+    `device.<entry>` — wall time the host spends inside the kernel."""
+    if not _enabled:
+        return _NULL_SPAN
+    _device_entry_check(entry)
+    return span(f"device.{entry}")
+
+
+def device_dispatch(entry: str, h2d_bytes: int = 0) -> int:
+    """Mark an async kernel dispatch; returns the dispatch timestamp
+    token for device_finish (0 when disabled). Counts the host→device
+    bytes staged for the call."""
+    if not _enabled:
+        return 0
+    _device_entry_check(entry)
+    count(f"device.{entry}.dispatches")
+    if h2d_bytes:
+        count("device.h2d_bytes", h2d_bytes)
+    return time.perf_counter_ns()
+
+
+def device_finish(entry: str, token: int, d2h_bytes: int = 0) -> None:
+    """Close a dispatch: `device.step.<entry>` is the dispatch→finish
+    latency — the device execution window isolated from host time
+    between the two calls."""
+    if not _enabled or not token:
+        return
+    observe(f"device.step.{entry}", time.perf_counter_ns() - token)
+    if d2h_bytes:
+        count("device.d2h_bytes", d2h_bytes)
+
+
+def device_bytes(h2d: int = 0, d2h: int = 0) -> None:
+    """Count transfer bytes for a blocking entry (device_step path)."""
+    if not _enabled:
+        return
+    if h2d:
+        count("device.h2d_bytes", h2d)
+    if d2h:
+        count("device.d2h_bytes", d2h)
 
 
 # --- merge / snapshot ---------------------------------------------------
@@ -495,6 +1090,14 @@ def prometheus_text() -> str:
         "# TYPE tbtpu_gauge gauge",
     ]
     g = gauges()  # locked snapshot: worker threads set gauges mid-scrape
+    # Pipeline occupancy (Little's law over the lifecycle registry):
+    # mean prepares resident per stage, from the snapshot already merged
+    # above — no second cross-thread merge per scrape.
+    occ = _stage_occupancy(
+        lambda e: snap.get(e, {}).get("total_ms", 0.0), _op_window_ns()
+    )
+    for stage, v in occ.items():
+        g[f"op.occupancy.{stage}"] = v
     for name in sorted(g):
         lines.append(
             f'tbtpu_gauge{{name="{_label_escape(name)}"}} {g[name]:.9g}'
@@ -532,8 +1135,17 @@ async def serve_metrics(port: int, host: str = "127.0.0.1"):
             elif path.startswith("/trace"):
                 body = json.dumps(export_trace()).encode()
                 ctype = "application/json"
+            elif path.startswith("/lifecycle"):
+                # Per-op queue/service decomposition + occupancy + flight
+                # status — the machine-readable block the benchmark
+                # driver folds into its result line.
+                body = json.dumps(lifecycle_summary()).encode()
+                ctype = "application/json"
+            elif path.startswith("/flight"):
+                body = json.dumps({"ops": flight_records()}).encode()
+                ctype = "application/json"
             else:
-                body = b"tigerbeetle-tpu observability: /metrics /trace\n"
+                body = b"tigerbeetle-tpu observability: /metrics /trace /lifecycle /flight\n"
                 ctype = "text/plain; charset=utf-8"
                 status = "404 Not Found" if path != "/" else "200 OK"
             writer.write(

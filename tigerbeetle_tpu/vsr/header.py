@@ -331,11 +331,16 @@ def make(command: int, cluster: int = 0, **fields) -> Header:
 class Message:
     """Header + body; checksums sealed on send."""
 
-    __slots__ = ("header", "body")
+    # lifecycle: the op's tracer.OpRecord riding WITH the message from
+    # bus arrival through prepare/WAL/commit/reply (tracer.py per-op
+    # lifecycle layer). None when tracing is off or the message is not a
+    # tracked request/prepare; never serialized.
+    __slots__ = ("header", "body", "lifecycle")
 
     def __init__(self, header: Header, body: bytes = b"") -> None:
         self.header = header
         self.body = body
+        self.lifecycle = None
 
     def seal(self) -> "Message":
         self.header.set_checksum_body(self.body)

@@ -57,16 +57,19 @@ class WalWriter:
         )
         self._thread.start()
 
-    def submit(self, segments, cb: Callable[[], None]) -> None:
+    def submit(self, segments, cb: Callable[[], None], lc=None) -> None:
+        """Queue one slot write; `lc` (optional tracer.OpRecord) gets its
+        WAL write-start/durable stamps on the writer thread — the
+        queue-wait vs write split of the lifecycle decomposition."""
         tidy_runtime.assert_role("loop")
         with self._cond:
-            self._pending.append((segments, cb))
+            self._pending.append((segments, cb, lc))
             tracer.gauge("pipeline.wal.depth", len(self._pending))
             self._cond.notify_all()
 
     def barrier(self, cb: Callable[[], None]) -> None:
         with self._cond:
-            self._pending.append((None, cb))
+            self._pending.append((None, cb, None))
             self._cond.notify_all()
 
     def drain(self) -> None:
@@ -107,7 +110,8 @@ class WalWriter:
                 # histogram (as opposed to stage.wal, the loop-side
                 # enqueue cost).
                 if getattr(self._storage, "supports_direct", False):
-                    for segments, cb in batch:
+                    for segments, cb, lc in batch:
+                        tracer.op_stamp(lc, tracer.OP_WAL_WRITE)
                         with tracer.span("wal.write"):
                             for offset, chunks, durable in segments or ():
                                 if durable:
@@ -117,11 +121,13 @@ class WalWriter:
                                     for c in chunks:
                                         self._storage.write(pos, c)
                                         pos += len(c)
+                        tracer.op_stamp(lc, tracer.OP_WAL_DURABLE)
                         self._post(cb)
                 else:
                     with tracer.span("wal.write"):
                         wrote = False
-                        for segments, _cb in batch:
+                        for segments, _cb, lc in batch:
+                            tracer.op_stamp(lc, tracer.OP_WAL_WRITE)
                             for offset, chunks, _durable in segments or ():
                                 pos = offset
                                 for c in chunks:
@@ -130,7 +136,10 @@ class WalWriter:
                                 wrote = True
                         if wrote:
                             self._storage.sync()
-                    for _segments, cb in batch:
+                    for _segments, cb, lc in batch:
+                        # Group-commit shape: the batch is durable at the
+                        # shared sync, so every entry's write ends here.
+                        tracer.op_stamp(lc, tracer.OP_WAL_DURABLE)
                         self._post(cb)
             except Exception as e:  # noqa: BLE001 — fail-stop, never wedge
                 # A failed WAL write means acks can never be granted again:
@@ -142,6 +151,7 @@ class WalWriter:
                 def _poison() -> None:
                     raise RuntimeError(f"WAL durable write failed: {err!r}") from err
 
+                tracer.flight_exception(f"wal: {err!r}")
                 self._post(_poison)
                 with self._cond:
                     self._stopped = True
@@ -189,9 +199,14 @@ class Journal:
         h = self.headers.get(self.slot_for_op(op))
         return h is None or h["op"] <= op
 
-    def write_prepare(self, message: Message, sync: bool = True) -> None:
+    def write_prepare(self, message: Message, sync: bool = True, lc=None) -> None:
+        # Synchronous path: enqueue == write start (no queue), durable at
+        # return — the lifecycle decomposition degenerates cleanly.
+        tracer.op_stamp(lc, tracer.OP_WAL_ENQUEUE)
+        tracer.op_stamp(lc, tracer.OP_WAL_WRITE)
         with tracer.span("journal.write_prepare"):
             self._write_prepare(message, sync)
+        tracer.op_stamp(lc, tracer.OP_WAL_DURABLE)
 
     def _slot_prologue(self, message: Message, write_header_ring: bool = True) -> tuple:
         """Shared bookkeeping for BOTH write paths (sync and async): the
@@ -237,7 +252,9 @@ class Journal:
         if sync:
             self.storage.sync()
 
-    def write_prepare_async(self, message: Message, on_durable: Callable[[], None]) -> None:
+    def write_prepare_async(
+        self, message: Message, on_durable: Callable[[], None], lc=None
+    ) -> None:
         """Queue a prepare's durable body write on the WAL writer thread;
         `on_durable` is posted to the event loop once the slot is on disk
         (ack-after-durable). The redundant header ring is written buffered
@@ -245,6 +262,7 @@ class Journal:
         torn (classified `dirty`, ring rewritten), so acks need only the
         body durable."""
         assert self.writer is not None
+        tracer.op_stamp(lc, tracer.OP_WAL_ENQUEUE)
         with tracer.span("stage.wal"):
             slot, hraw, base = self._slot_prologue(message, write_header_ring=False)
             self.inflight[slot] = message
@@ -264,6 +282,7 @@ class Journal:
                     (base, chunks, True),
                 ],
                 _done,
+                lc=lc,
             )
 
     def _drain_writer(self) -> None:

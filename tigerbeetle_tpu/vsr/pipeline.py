@@ -169,6 +169,10 @@ class CommitExecutor:
         def _raise() -> None:
             raise RuntimeError(f"commit executor stage failed: {err!r}") from err
 
+        # Flight recorder: the op records leading up to a stage poison
+        # are the post-hoc causality for the crash — dump before the
+        # loop re-raises.
+        tracer.flight_exception(f"commit stage: {err!r}")
         self._post(_raise)
         with self._cond:
             self._stopped = True
@@ -377,6 +381,7 @@ class StoreExecutor:
         def _raise() -> None:
             raise RuntimeError(f"store executor stage failed: {err!r}") from err
 
+        tracer.flight_exception(f"store stage: {err!r}")
         self._post(_raise)
         with self._cond:
             self._stopped = True

@@ -238,6 +238,10 @@ class Replica:
         # The _finish_commit (store/compaction) of an already-committed op
         # faulted: it must complete after repair BEFORE any further op.
         self._finish_pending = False
+        # That op's lifecycle record, so the resumed finish still gets
+        # its store stamps (the faulted tail op is exactly the record
+        # the flight dump exists to explain).
+        self._finish_lc = None
         # A checkpoint's trailer write faulted mid-drain (corrupt
         # compaction input found while draining): retried after repair.
         self._checkpoint_pending = False
@@ -736,6 +740,11 @@ class Replica:
         del self.clients[oldest]
 
     def _append_request(self, msg: Message) -> None:
+        if msg.lifecycle is None and tracer.enabled():
+            # In-process embedders (simulator, profile_e2e) bypass the
+            # bus ingress stamp — arrival is acceptance here.
+            msg.lifecycle = tracer.op_begin()
+            tracer.op_stamp(msg.lifecycle, tracer.OP_ARRIVE)
         if len(self.pipeline) >= self.config.pipeline_max:
             self.request_queue.append(msg)
             return
@@ -775,10 +784,19 @@ class Replica:
         prepare = Message(ph, request.body).seal_with_body_checksum(
             request.header["checksum_body"]
         )
+        # The lifecycle record moves from the request onto its prepare:
+        # request-queue wait ends here, the prepare/WAL leg begins.
+        lc = prepare.lifecycle = request.lifecycle
+        tracer.op_stamp(lc, tracer.OP_PREPARE)
+        tracer.op_meta(
+            lc, op=self.op, client=int(rh["client"]),
+            request=int(rh["request"]), operation=int(rh["operation"]),
+            n_events=int(n_events),
+        )
         entry = Pipeline(prepare)
         self.pipeline.append(entry)
         if self.wal_writer is None:
-            self.journal.write_prepare(prepare)
+            self.journal.write_prepare(prepare, lc=lc)
             entry.ok_from.add(self.replica)
             self._replicate_chain(prepare)
             self._check_pipeline_quorum()
@@ -790,7 +808,7 @@ class Replica:
             # lands (ack-after-durable).
             op, cks, view = self.op, ph["checksum"], self.view
             self.journal.write_prepare_async(
-                prepare, lambda: self._on_wal_durable(op, cks, view)
+                prepare, lambda: self._on_wal_durable(op, cks, view), lc=lc
             )
             self._replicate_chain(prepare)
 
@@ -1019,6 +1037,11 @@ class Replica:
                 continue
             self.pipeline.pop(0)
             self.commit_max = max(self.commit_max, op)
+            lc = entry.message.lifecycle
+            # Serial inline commit: quorum reached IS the commit submit,
+            # and execution starts immediately (queue.commit ≈ 0).
+            tracer.op_stamp(lc, tracer.OP_COMMIT_SUBMIT)
+            tracer.op_stamp(lc, tracer.OP_EXEC_START)
             try:
                 reply = self._execute(entry.message)
             except GridReadFault as fault:
@@ -1030,6 +1053,7 @@ class Replica:
                 self._begin_grid_repair(fault)
                 break
             self.commit_min = op
+            tracer.op_stamp(lc, tracer.OP_EXEC_END)
             if reply is not None:
                 # Reply first: it depends only on validate+post, and
                 # asyncio pushes it to the socket synchronously when the
@@ -1037,12 +1061,15 @@ class Replica:
                 # against our store/compaction work below.
                 tracer.count("vsr.replies")
                 self.bus.send_to_client(entry.message.header["client"], reply)
+                tracer.op_stamp(lc, tracer.OP_REPLY)
+            tracer.op_finish(lc)
             try:
-                self._finish_commit()
+                self._finish_commit(lc)
             except GridReadFault as fault:
                 # Already committed; the deferred store/beat must finish
                 # after repair BEFORE any further op executes.
                 self._finish_pending = True
+                self._finish_lc = lc
                 self._begin_grid_repair(fault)
                 break
             if not self._checkpoint_guarded():
@@ -1150,17 +1177,23 @@ class Replica:
                 if msg is None:
                     self._repair_gaps(target=op)
                     break
+                lc = self._lc_for(msg, op)
+                tracer.op_stamp(lc, tracer.OP_COMMIT_SUBMIT)
+                tracer.op_stamp(lc, tracer.OP_EXEC_START)
                 try:
                     self._execute(msg)
                 except GridReadFault as fault:
                     self._begin_grid_repair(fault)
                     break
                 self.commit_min += 1
+                tracer.op_stamp(lc, tracer.OP_EXEC_END)
+                tracer.op_finish(lc)
                 self._drop_target(op)
                 try:
-                    self._finish_commit()
+                    self._finish_commit(lc)
                 except GridReadFault as fault:
                     self._finish_pending = True
+                    self._finish_lc = lc
                     self._begin_grid_repair(fault)
                     break
                 if not self._checkpoint_guarded():
@@ -1231,6 +1264,8 @@ class Replica:
         already-applied store phase and re-enters the beat at the faulted
         stage (sm._beat_stage) — identical to the serial retry."""
         sm = self.state_machine
+        lc = job.get("lc")
+        tracer.op_stamp(lc, tracer.OP_STORE_START)
         try:
             with tracer.span("stage.store_async"):
                 store = job.get("store")
@@ -1247,6 +1282,8 @@ class Replica:
         except GridReadFault as fault:
             job["fault"] = fault
             return job
+        tracer.op_stamp(lc, tracer.OP_STORE_END)
+        tracer.op_store_done(lc)
         return None
 
     def _drain_store_faults(self) -> None:
@@ -1288,9 +1325,31 @@ class Replica:
             return False
         return True
 
+    def _lc_for(self, msg: Message, op: int):
+        """The op's lifecycle record: the one riding the message (primary
+        path), or a fresh one for journal-derived commits (backups,
+        catch-up) so the execute/store decomposition covers them too —
+        their earlier stamps are simply absent."""
+        lc = msg.lifecycle
+        if lc is None and tracer.enabled():
+            h = msg.header
+            lc = msg.lifecycle = tracer.op_begin()
+            n_events = (
+                (int(h["size"]) - hdr.HEADER_SIZE)
+                // _event_dtype(h["operation"]).itemsize
+                if h["operation"] >= 128 else 0
+            )
+            tracer.op_meta(
+                lc, op=op, client=int(h["client"]), request=int(h["request"]),
+                operation=int(h["operation"]), n_events=n_events,
+            )
+        return lc
+
     def _stage_submit(self, msg: Message, op: int, entry: Optional[Pipeline]) -> None:
         assert op == self.commit_staged + 1
-        job = {"op": op, "msg": msg, "entry": entry}
+        lc = self._lc_for(msg, op)
+        tracer.op_stamp(lc, tracer.OP_COMMIT_SUBMIT)
+        job = {"op": op, "msg": msg, "entry": entry, "lc": lc}
         self._staged.append(job)
         self.executor.submit(job)
 
@@ -1323,6 +1382,7 @@ class Replica:
                 # its deferred store/beat faulted after the fact and must
                 # complete after repair BEFORE any further op.
                 self._finish_pending = True
+                self._finish_lc = job.get("lc")
                 self._stage_reclaim(None, job["finish_fault"])
                 continue
             self._stage_complete(job)
@@ -1367,6 +1427,11 @@ class Replica:
                 # This job never executed: back to the queue head.
                 return publish, [job], False
         if handle is not None:
+            # Double-buffered device path: the op's execution begins at
+            # dispatch — the settle stamp must not overwrite it, so the
+            # commit-queue wait excludes device time (device time itself
+            # is the device-step profiler's dispatch→finish row).
+            tracer.op_stamp_first(job.get("lc"), tracer.OP_EXEC_START)
             job["_handle"] = handle
             self._stage_pending = job
             return None, [], True
@@ -1406,23 +1471,26 @@ class Replica:
         ops publish only after their finish, so the loop's checkpoint
         always sees a quiescent state machine. Returns (publish, ok)."""
         boundary = job["op"] % self.config.checkpoint_interval == 0
+        lc = job.get("lc")
+        tracer.op_stamp_first(lc, tracer.OP_EXEC_START)
         try:
             run_exec(job)
             job["committed"] = True
         except GridReadFault as fault:
             job["fault"] = fault
             return job, False  # execute-phase fault: not committed
+        tracer.op_stamp(lc, tracer.OP_EXEC_END)
         self._stage_emit(job)
         if not boundary:
             self.executor.complete(job)
         try:
-            self._finish_commit()
+            self._finish_commit(lc)
         except GridReadFault as fault:
             if boundary:
                 job["fault"] = fault
                 return job, False  # completion carries the finish fault
             # Completion already out: publish a finish-fault marker.
-            return {"op": job["op"], "finish_fault": fault}, False
+            return {"op": job["op"], "finish_fault": fault, "lc": lc}, False
         if boundary:
             self.executor.complete(job)
         return None, True
@@ -1457,15 +1525,19 @@ class Replica:
         self._drop_target(op)
         spec = job.get("spec")
         reply = job.get("reply")
+        lc = job.get("lc")
         if job.get("entry") is not None and reply is not None:
             # Reply as soon as the completion lands — asyncio pushes it to
             # the socket while the executor already works on later ops.
             tracer.count("vsr.replies")
             self.bus.send_to_client(spec["client"], reply)
+            tracer.op_stamp(lc, tracer.OP_REPLY)
+        tracer.op_finish(lc)
         if fault is not None:
             # Finish-phase fault: committed, but the op's deferred
             # store/beat must complete after repair BEFORE any further op.
             self._finish_pending = True
+            self._finish_lc = lc
             self._stage_reclaim(None, fault)
             return
         if not self._checkpoint_guarded():
@@ -1482,6 +1554,14 @@ class Replica:
         if self.executor is not None:
             self.executor.reset()
         jobs = ([faulted_job] if faulted_job is not None else []) + pending
+        for j in jobs:
+            # The retry re-stamps execution (op_stamp_first): stale
+            # stamps from the faulted attempt must not survive, or
+            # service.execute would absorb the whole repair window.
+            tracer.op_clear(
+                j.get("lc"), tracer.OP_COMMIT_SUBMIT,
+                tracer.OP_EXEC_START, tracer.OP_EXEC_END,
+            )
         entries = [j["entry"] for j in jobs if j.get("entry") is not None]
         for e in reversed(entries):
             self.pipeline.insert(0, e)
@@ -1829,6 +1909,7 @@ class Replica:
         # beat resume point) are void.
         self._grid_repair = None
         self._finish_pending = False
+        self._finish_lc = None
         self.state_machine._beat_stage = 0
         from tigerbeetle_tpu.io.grid import FreeSet
 
@@ -2124,10 +2205,12 @@ class Replica:
             self.store_executor.resume(job)
         elif self._finish_pending:
             self._finish_pending = False
+            lc, self._finish_lc = self._finish_lc, None
             try:
-                self._finish_commit()
+                self._finish_commit(lc)
             except GridReadFault as fault:
                 self._finish_pending = True
+                self._finish_lc = lc
                 self._begin_grid_repair(fault)
                 return
         # Retry (or perform) any due checkpoint — _maybe_checkpoint no-ops
@@ -2559,7 +2642,7 @@ class Replica:
             self._begin_grid_repair(fault)
             return False
 
-    def _finish_commit(self) -> None:
+    def _finish_commit(self, lc=None) -> None:
         """Deferred tail of the per-op apply sequence: the state machine's
         deferred object store, then the compaction beat. Runs AFTER the
         reply hits the wire (the reply depends only on validate+post) but
@@ -2569,16 +2652,24 @@ class Replica:
         checker). With the async store stage attached, the same sequence
         runs as a coalesced job on the store thread instead (jobs drain
         strictly in op order, preserving the write sequence exactly);
-        submit() backpressure bounds the queue."""
+        submit() backpressure bounds the queue. `lc` (the op's lifecycle
+        record) gets the store-queue vs store-service stamps — on this
+        thread when inline, on the store thread when async."""
         sm = self.state_machine
         if self.store_executor is not None:
+            tracer.op_stamp(lc, tracer.OP_STORE_SUBMIT)
             self.store_executor.submit({
                 "op": getattr(self, "last_committed_op", 0),
                 "store": sm.take_deferred_store(),
+                "lc": lc,
             })
             return
+        tracer.op_stamp(lc, tracer.OP_STORE_SUBMIT)
+        tracer.op_stamp(lc, tracer.OP_STORE_START)
         sm.flush_deferred()
         sm.compact_beat()
+        tracer.op_stamp(lc, tracer.OP_STORE_END)
+        tracer.op_store_done(lc)
 
     def _execute_op(self, prepare: Message) -> bytes:
         """State-machine dispatch for one committed prepare → result

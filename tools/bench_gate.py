@@ -1,7 +1,11 @@
 """Bench regression gate: compare a fresh `bench.py` run against the
 latest recorded round benchmark (BENCH_r*.json) and fail on a >10%
 regression in the e2e metrics (accepted throughput, client-perceived
-p50) or the LSM store metrics (config5 ingest / major-compaction rates).
+p50/p99, the lifecycle queue-wait/service totals) or the LSM store
+metrics (config5 ingest / major-compaction rates). Lifecycle metrics
+absent from a pre-lifecycle baseline are n/a, not failures; occupancy
+is recorded but not gated (throughput × latency has no monotone-good
+direction).
 Steady-state jit compile counts (`steady_compiles`, recorded per device
 workload by bench.py via the tidy compile registry) are gated EXACTLY:
 any drift from the baselined value means a retrace crept into the hot
@@ -49,6 +53,16 @@ GATED = (
     ("end_to_end", "load_accepted_tx_per_s", True),
     ("end_to_end", "perceived_p50_ms", False),
     ("end_to_end", "perceived_p99_ms", False),
+    # Lifecycle decomposition (server-side, from the /lifecycle scrape):
+    # aggregate queue-wait and service time per op. Absent from
+    # pre-lifecycle BENCH_r*.json baselines — that is n/a, not a failure;
+    # the gate arms once a baseline records them. The occupancy_* fields
+    # are recorded but deliberately NOT gated: by Little's law occupancy
+    # = throughput × latency, so it has no monotone-good direction (a
+    # genuine latency win at constant throughput LOWERS it) — both of
+    # its factors are already gated above.
+    ("end_to_end", "queue_wait_total_p50_ms", False),
+    ("end_to_end", "service_total_p50_ms", False),
     ("config5_lsm", "ingest_rows_per_s", True),
     ("config5_lsm", "major_compaction_rows_per_s", True),
 )

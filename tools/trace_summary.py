@@ -1,20 +1,28 @@
 """Trace summary: per-thread / per-stage table from a saved Chrome
 trace-event JSON file (tracer.export_trace / tracer.dump / the live
-`/trace` endpoint).
+`/trace` endpoint), or per-op critical-path waterfalls from a flight
+recorder dump.
 
-Prints, per thread: busy time (union of its span intervals), idle time,
-and the per-event stats (count, total, p50/p99 exact from the raw
-durations — the offline tool can afford exact percentiles); then the
-cross-thread overlap histogram (how much wall time had 0/1/2/.. threads
-busy) — the one-glance answer to "does the pipeline actually overlap,
-and which stage stalls it".
+Default view prints, per thread: busy time (union of its span
+intervals), idle time, and the per-event stats (count, total, p50/p99
+exact from the raw durations — the offline tool can afford exact
+percentiles); then the cross-thread overlap histogram (how much wall
+time had 0/1/2/.. threads busy) — the one-glance answer to "does the
+pipeline actually overlap, and which stage stalls it".
+
+`--ops` renders the per-operation lifecycle waterfalls from a flight
+recorder dump (tracer.flight_trip / the live `/flight` endpoint): each
+op's queue-wait and service segments in hand-off order, scaled bars —
+the "where did this prepare spend its 225 ms" view.
 
 Usage:
     python tools/trace_summary.py /tmp/tbtpu_trace.json
+    python tools/trace_summary.py --ops /tmp/tbtpu_flight_1234_1.json
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import sys
 from collections import defaultdict
@@ -118,12 +126,81 @@ def summarize(path: str) -> str:
     return "\n".join(lines)
 
 
+# Lifecycle components in hand-off order (mirrors tracer.OP_COMPONENTS
+# + OP_STORE_COMPONENTS; duplicated here so the offline tool needs no
+# package import).
+_OP_ORDER = (
+    "op.queue.request", "op.service.prepare", "op.queue.wal",
+    "op.service.wal", "op.queue.quorum", "op.queue.commit",
+    "op.service.execute", "op.service.reply",
+    "op.queue.store", "op.service.store",
+)
+_BAR_WIDTH = 36
+
+
+def summarize_ops(path: str, limit: int = 16) -> str:
+    """Per-op waterfalls from a flight-recorder dump: one block per op,
+    segments in hand-off order, bars scaled to the dump's slowest op so
+    outliers read at a glance."""
+    with open(path) as f:
+        doc = json.load(f)
+    recs = doc.get("ops", doc.get("records", []))
+    lines = [f"flight dump: {path}"]
+    if "reason" in doc:
+        lines.append(f"tripped: {doc['reason']}")
+    lines.append(f"{len(recs)} op records retained")
+    if not recs:
+        return "\n".join(lines)
+    shown = recs[-limit:] if limit else recs
+    scale_ms = max(
+        (sum(r.get("components", {}).values()) for r in shown), default=0.0
+    ) or 1.0
+    totals: Dict[str, float] = defaultdict(float)
+    for r in recs:
+        for comp, ms in r.get("components", {}).items():
+            totals[comp] += ms
+    for r in shown:
+        comps = r.get("components", {})
+        perceived = r.get("perceived_ms")
+        head = (
+            f"\nop {r.get('op', '?')}  operation={r.get('operation', 0)} "
+            f"events={r.get('n_events', 0)}"
+        )
+        if perceived is not None:
+            head += f"  perceived {perceived:.2f} ms"
+        store_ms = sum(ms for c, ms in comps.items() if ".store" in c)
+        if store_ms:
+            head += f"  (+{store_ms:.2f} ms trailing store)"
+        lines.append(head)
+        for comp in _OP_ORDER:
+            if comp not in comps:
+                continue
+            ms = comps[comp]
+            bar = "#" * max(1 if ms > 0 else 0,
+                            round(_BAR_WIDTH * ms / scale_ms))
+            lines.append(f"  {comp[3:]:18s} {ms:9.3f} ms  {bar}")
+    lines.append(
+        f"\ncomponent totals over all {len(recs)} records (critical-path"
+        " ranking):"
+    )
+    for comp in sorted(totals, key=lambda c: -totals[c]):
+        lines.append(f"  {comp[3:]:18s} {totals[comp]:10.2f} ms")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
-    if len(argv) != 1:
-        print(__doc__, file=sys.stderr)
-        return 2
-    print(summarize(argv[0]))
+    p = argparse.ArgumentParser(
+        prog="trace_summary", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("path", help="trace JSON (default view) or flight dump (--ops)")
+    p.add_argument("--ops", action="store_true",
+                   help="render per-op lifecycle waterfalls from a flight dump")
+    p.add_argument("--limit", type=int, default=16,
+                   help="ops shown in the waterfall view (0 = all)")
+    args = p.parse_args(sys.argv[1:] if argv is None else argv)
+    print(summarize_ops(args.path, args.limit) if args.ops
+          else summarize(args.path))
     return 0
 
 
