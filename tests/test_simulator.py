@@ -5,12 +5,21 @@ out-of-band (python -m tigerbeetle_tpu.simulator --sweep 200)."""
 
 import pytest
 
-from tigerbeetle_tpu.simulator import EXIT_PASS, Simulator
+from tigerbeetle_tpu.simulator import EXIT_PASS, Simulator, run_smoke
 
 
 @pytest.mark.parametrize("seed", [1, 5, 7, 12, 14, 24])
 def test_vopr_seed(seed):
     assert Simulator(seed, requests=25).run() == EXIT_PASS
+
+
+def test_smoke_set_covers_chaos_schedules_and_passes():
+    """`python -m tigerbeetle_tpu.simulator --smoke` as a tier-1 gate:
+    run_smoke itself asserts the fixed seed set covers a crash schedule
+    AND a corruption schedule (returning EXIT_LIVENESS on a taxonomy
+    change that tames them), then every seed must pass within the
+    budget."""
+    assert run_smoke() == EXIT_PASS
 
 
 def test_vopr_big_batch_schedule():

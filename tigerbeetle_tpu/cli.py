@@ -143,15 +143,18 @@ def cmd_start(args) -> int:
     server = ReplicaServer(
         replica, addresses, overlap=overlap, store_async=store_async
     )
-    replica.open()
-    host, port = addresses[args.replica]
 
     from tigerbeetle_tpu import tracer
 
     if args.metrics_port:
         # The scrape surface implies recording: a /metrics endpoint over
-        # a disabled registry would serve an empty page forever.
+        # a disabled registry would serve an empty page forever. Enabled
+        # BEFORE open() so the boot-time recovery stamps (WAL-replay
+        # gauges, vsr.recovery_state — docs/CHAOS.md) land in the
+        # registry a chaos harness scrapes after a restart.
         tracer.enable()
+    replica.open()
+    host, port = addresses[args.replica]
 
     async def _serve() -> None:
         # Bind BEFORE announcing: tooling (benchmark driver, scripts) waits

@@ -286,14 +286,71 @@ def run_seed(seed: int, requests: int, verbose: bool) -> int:
         return EXIT_CRASH
 
 
+# Fixed smoke seed set (--smoke): a tier-1-sized slice of the VOPR so the
+# chaos paths cannot bit-rot between full sweeps. Chosen (and ASSERTED
+# below, so a schedule-taxonomy edit that tames them fails loudly) to
+# cover: a crash/restart schedule (seed 0), a grid-corruption schedule
+# (seed 1), the single-replica fail-stop path (seed 2), and a combined
+# crash+corruption 3-replica schedule (seed 9).
+SMOKE_SEEDS = (0, 1, 2, 9)
+SMOKE_REQUESTS = 12
+SMOKE_BUDGET_S = 120.0
+
+
+def run_smoke(budget_s: float = SMOKE_BUDGET_S, verbose: bool = False) -> int:
+    """Run the fixed smoke seed set under a wall-clock budget."""
+    import time
+
+    crash_covered = corrupt_covered = False
+    for seed in SMOKE_SEEDS:
+        sim = Simulator(seed, requests=SMOKE_REQUESTS)
+        crash_covered |= bool(sim.crash_at)
+        corrupt_covered |= sim.corrupt_grid_after is not None
+    if not (crash_covered and corrupt_covered):
+        print(
+            f"smoke: seed set {SMOKE_SEEDS} no longer covers "
+            f"crash={crash_covered} corruption={corrupt_covered} — the "
+            "schedule taxonomy changed; repick SMOKE_SEEDS",
+            file=sys.stderr,
+        )
+        return EXIT_LIVENESS
+    t0 = time.perf_counter()
+    worst = EXIT_PASS
+    for seed in SMOKE_SEEDS:
+        rc = run_seed(seed, SMOKE_REQUESTS, verbose)
+        if rc != EXIT_PASS:
+            print(f"smoke seed {seed}: FAIL exit={rc}", file=sys.stderr)
+            worst = rc if worst == EXIT_PASS else worst
+        elapsed = time.perf_counter() - t0
+        if elapsed > budget_s:
+            print(
+                f"smoke: budget exceeded ({elapsed:.1f}s > {budget_s:.0f}s) "
+                f"— the smoke set must stay tier-1-sized", file=sys.stderr,
+            )
+            return worst if worst != EXIT_PASS else EXIT_LIVENESS
+    print(
+        f"smoke: {len(SMOKE_SEEDS)} seeds in "
+        f"{time.perf_counter() - t0:.1f}s — "
+        f"{'PASS' if worst == EXIT_PASS else 'FAIL'}"
+    )
+    return worst
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("seed", type=int, nargs="?", default=None)
     p.add_argument("--sweep", type=int, default=0,
                    help="run seeds 0..N-1; report failing seeds (vopr.zig)")
+    p.add_argument("--smoke", action="store_true",
+                   help="run the fixed tier-1 smoke seed set (crash + "
+                        "corruption schedules) under a time budget")
+    p.add_argument("--budget-s", type=float, default=SMOKE_BUDGET_S,
+                   help="wall-clock budget for --smoke")
     p.add_argument("--requests", type=int, default=30)
     p.add_argument("--verbose", action="store_true")
     args = p.parse_args(argv)
+    if args.smoke:
+        return run_smoke(budget_s=args.budget_s, verbose=args.verbose)
     if args.sweep:
         from tigerbeetle_tpu import tracer
 

@@ -378,6 +378,38 @@ class Cluster:
             self.step()
         raise TimeoutError(f"condition not reached in {max_ticks} ticks")
 
+    def run_wall(self, duration_s: float, schedule=(), on_step=None,
+                 until=None, step_fn=None) -> float:
+        """Wall-clock scenario mode (the chaos harness, testing/chaos.py):
+        step the cluster (or `step_fn`, e.g. the harness's crash-
+        converting wrapper) continuously for up to `duration_s` wall
+        seconds, firing each `(at_s, fn)` fault of `schedule` exactly
+        once when its offset elapses, stopping early when `until()`
+        holds; `on_step(elapsed_s)` runs after every step (load pumping,
+        throughput sampling). Returns the seconds actually elapsed.
+        Unlike run()/run_until, a run_wall execution is NOT
+        tick-reproducible — wall time decides interleavings — but the
+        COMMITTED chain must still satisfy the determinism checkers,
+        which is exactly what the chaos scenarios assert."""
+        import time
+
+        step = self.step if step_fn is None else step_fn
+        t0 = time.perf_counter()
+        pending = sorted(schedule, key=lambda e: e[0])
+        i = 0
+        while True:
+            elapsed = time.perf_counter() - t0
+            if elapsed >= duration_s:
+                return elapsed
+            while i < len(pending) and elapsed >= pending[i][0]:
+                pending[i][1]()
+                i += 1
+            step()
+            if on_step is not None:
+                on_step(elapsed)
+            if until is not None and until():
+                return time.perf_counter() - t0
+
     def quiesce(self) -> None:
         """Drain every replica's commit AND store stage and apply
         completions (the checkers read commit_min / state-machine /

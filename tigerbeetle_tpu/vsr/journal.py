@@ -455,6 +455,12 @@ class Journal:
                 self.timestamp_max = max(self.timestamp_max, int(ph["timestamp"]))
                 out.append(ph)
                 self.dirty.add(slot)  # header ring needs rewrite
+        # Replay-progress stamps (docs/CHAOS.md recovery lifecycle): how
+        # much of the WAL survived the crash, and how much needs repair —
+        # scraped from /metrics by a chaos harness after a restart.
+        tracer.gauge("vsr.recovery.journal_slots_recovered", len(self.headers))
+        tracer.gauge("vsr.recovery.journal_slots_faulty", len(self.faulty))
+        tracer.gauge("vsr.recovery.journal_slots_dirty", len(self.dirty))
         return out
 
     def highest_op(self) -> int:

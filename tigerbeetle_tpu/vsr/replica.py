@@ -47,6 +47,19 @@ STATUS_NORMAL = "normal"
 STATUS_VIEW_CHANGE = "view_change"
 STATUS_RECOVERING = "recovering"
 
+# vsr.recovery_state gauge values (docs/CHAOS.md recovery lifecycle):
+# the dominant phase between a crash and the first post-restart commit at
+# the cluster tip. GRID_REPAIR also covers normal-operation repair gates
+# (commits stall identically either way).
+RECOVERY_STATE_NORMAL = 0
+RECOVERY_STATE_DISCOVER = 1  # restarted, learning the cluster's view
+RECOVERY_STATE_WAL_REPLAY = 2  # open(): re-executing committed prepares
+RECOVERY_STATE_VIEW_CHANGE = 3
+RECOVERY_STATE_SYNC = 4  # chunked checkpoint-trailer transfer
+RECOVERY_STATE_BLOCK_SYNC = 5  # fetching referenced grid blocks
+RECOVERY_STATE_GRID_REPAIR = 6  # commit gate: block repair / parked finish
+RECOVERY_STATE_CATCH_UP = 7  # normal status, commit_min < commit_max
+
 # Scoped logger (reference std.log scoped loggers; silent unless the
 # embedder configures logging — the simulator leaves it off for speed).
 log = logging.getLogger("tigerbeetle_tpu.replica")
@@ -266,6 +279,21 @@ class Replica:
         # replica → (view, is_normal) pongs collected while recovering.
         self._recovery_pongs: Dict[int, tuple] = {}
 
+        # Recovery lifecycle observability (docs/CHAOS.md): open() fills
+        # wal_replay_{ops,s} / replay_ops_per_s, the caught-up detector in
+        # _recovery_tick adds time_to_rejoin_s. Wall-clock here is
+        # observability-only and never reaches replicated state; the
+        # deterministic phase tracking (stall detection, gauge) runs on
+        # tick counts.
+        self.recovery_stats: Dict[str, float] = {}
+        self._recovery_active = False
+        self._recovery_t0 = 0.0
+        self._recovery_progress_tick = 0
+        self._recovery_progress_commit = 0
+        self._recovery_progress_fetch = 0
+        self._recovery_stall_tripped = False
+        self._recovery_gauge_last = -1
+
         # commit-number → checksum chain, used by the state checker. Ops at
         # or below checksum_floor were recovered from a checkpoint snapshot
         # and have no individually recorded checksum.
@@ -356,6 +384,11 @@ class Replica:
         storage.sync()
 
     def open(self) -> None:
+        import time as _time
+
+        t_open = _time.perf_counter()  # tidy: allow=wall-clock — recovery observability only, never reaches replicated state
+        tracer.count("recovery.boot")
+        tracer.gauge("vsr.recovery_state", RECOVERY_STATE_WAL_REPLAY)
         st = self.superblock.open()
         assert st.cluster == self.cluster and st.replica == self.replica
         self.view = st.view
@@ -421,6 +454,7 @@ class Replica:
         self.journal.flush_dirty()
         self.op = max(self.journal.highest_op(), st.op_checkpoint)
 
+        replayed = 0
         if resume_block_sync is None:
             # Re-execute contiguous committed prepares beyond the checkpoint.
             replay_to = min(self.commit_max, self.op)
@@ -432,6 +466,7 @@ class Replica:
                 if not self._replay_exec(msg, op):
                     faulted = True
                     break
+                replayed += 1
             if self.replica_count == 1 and not faulted:
                 # Single replica: every durable prepare is committable.
                 for op in range(self.commit_min + 1, self.op + 1):
@@ -441,6 +476,7 @@ class Replica:
                         break
                     if not self._replay_exec(msg, op):
                         break
+                    replayed += 1
                 self.commit_max = max(self.commit_max, self.commit_min)
         if self.replica_count == 1:
             self.status = STATUS_NORMAL
@@ -456,6 +492,30 @@ class Replica:
         # Recovered journal ops not yet re-committed gate session judgement
         # the same way a new primary's inherited suffix does.
         self._eviction_floor = self.op
+
+        # Recovery lifecycle stamps (docs/CHAOS.md): WAL-replay phase done;
+        # the caught-up detector in _recovery_tick closes the window.
+        replay_s = _time.perf_counter() - t_open  # tidy: allow=wall-clock — recovery observability only, never reaches replicated state
+        self.recovery_stats = {
+            "wal_replay_ops": replayed,
+            "wal_replay_s": round(replay_s, 6),
+            "replay_ops_per_s": (
+                round(replayed / replay_s, 1) if replay_s > 0 and replayed
+                else 0.0
+            ),
+        }
+        tracer.observe("recovery.wal_replay", int(replay_s * 1e9))
+        tracer.gauge("vsr.recovery.wal_replay_ops", replayed)
+        tracer.gauge("vsr.recovery.wal_replay_s", round(replay_s, 6))
+        tracer.gauge(
+            "vsr.recovery.replay_ops_per_s",
+            self.recovery_stats["replay_ops_per_s"],
+        )
+        self._recovery_active = True
+        self._recovery_t0 = t_open
+        self._recovery_progress_tick = self.tick_count
+        self._recovery_progress_commit = self.commit_min
+        self._recovery_stall_tripped = False
         self.on_event("open", self)
 
     def _replay_exec(self, msg: Message, op: int) -> bool:
@@ -501,6 +561,7 @@ class Replica:
             self._send_clock_pings()
         self._sync_tick()
         self._grid_repair_tick()
+        self._recovery_tick()
         if self.status == STATUS_NORMAL:
             if self.is_primary:
                 if self.tick_count - self.last_commit_sent_tick >= COMMIT_HEARTBEAT_TIMEOUT:
@@ -515,6 +576,80 @@ class Replica:
                 self._vote_view_change(self.view + 1)
         elif self.status == STATUS_RECOVERING:
             self._recovering_tick()
+
+    # Recovery-stall flight-recorder threshold, in ticks without commit
+    # (or block-fetch) progress while recovery is active: ~15 s at the
+    # production server's 10 ms tick. Deterministic (tick-counted), so the
+    # simulator's virtual time never wall-clock-flakes it.
+    RECOVERY_STALL_TICKS = 1500
+
+    def _recovery_state_code(self) -> int:
+        """The vsr.recovery_state gauge value (docs/CHAOS.md taxonomy)."""
+        if self._block_sync is not None:
+            return RECOVERY_STATE_BLOCK_SYNC
+        if self._sync is not None:
+            return RECOVERY_STATE_SYNC
+        if self._grid_repair is not None or self._finish_pending:
+            return RECOVERY_STATE_GRID_REPAIR
+        if self.status == STATUS_VIEW_CHANGE:
+            return RECOVERY_STATE_VIEW_CHANGE
+        if self.status == STATUS_RECOVERING:
+            return RECOVERY_STATE_DISCOVER
+        if self._recovery_active and self.commit_min < self.commit_max:
+            return RECOVERY_STATE_CATCH_UP
+        return RECOVERY_STATE_NORMAL
+
+    def _recovery_tick(self) -> None:
+        """Recovery lifecycle bookkeeping (docs/CHAOS.md): maintain the
+        vsr.recovery_state gauge, detect caught-up — the first moment
+        after a restart the replica stands at the cluster tip with no
+        sync/repair gate active — and arm a flight-recorder dump when a
+        recovery stalls without progress (the post-hoc causality window
+        for a replica that never comes back)."""
+        code = self._recovery_state_code()
+        if code != self._recovery_gauge_last:
+            self._recovery_gauge_last = code
+            tracer.gauge("vsr.recovery_state", code)
+        if not self._recovery_active:
+            return
+        progressed = self.commit_min > self._recovery_progress_commit
+        if self._block_sync is not None:
+            fetched = self._block_sync.get("fetched", 0)
+            if fetched != self._recovery_progress_fetch:
+                self._recovery_progress_fetch = fetched
+                progressed = True
+        if progressed:
+            self._recovery_progress_commit = self.commit_min
+            self._recovery_progress_tick = self.tick_count
+        if code == RECOVERY_STATE_NORMAL:
+            import time as _time
+
+            t = _time.perf_counter() - self._recovery_t0  # tidy: allow=wall-clock — recovery observability only, never reaches replicated state
+            self.recovery_stats["time_to_rejoin_s"] = round(t, 6)
+            tracer.gauge("vsr.recovery.time_to_rejoin_s", round(t, 6))
+            tracer.observe("recovery.rejoin", int(t * 1e9))
+            tracer.count("recovery.caught_up")
+            self._recovery_active = False
+            log.info(
+                "replica %d: recovery caught up at op %d "
+                "(%.3fs since open, %d ops replayed)",
+                self.replica, self.commit_min, t,
+                int(self.recovery_stats.get("wal_replay_ops", 0)),
+            )
+            return
+        if (
+            not self._recovery_stall_tripped
+            and self.tick_count - self._recovery_progress_tick
+            > self.RECOVERY_STALL_TICKS
+        ):
+            self._recovery_stall_tripped = True
+            tracer.count("mark.recovery_stall")
+            tracer.flight_trip(
+                f"recovery stall: replica {self.replica} made no commit "
+                f"progress for {self.tick_count - self._recovery_progress_tick} "
+                f"ticks (state={code}, commit_min={self.commit_min}, "
+                f"commit_max={self.commit_max})"
+            )
 
     RECOVERING_PING_INTERVAL = 20
     RECOVERING_ELECTION_WAIT = 120
@@ -1852,6 +1987,7 @@ class Replica:
                 return
             s = None
         if s is None:
+            tracer.count("recovery.sync_begin")
             s = self._sync = {
                 "checkpoint_op": sync_op, "ident": ident,
                 "count": h["commit"], "total": h["timestamp"],
@@ -2240,6 +2376,7 @@ class Replica:
             self.superblock.checkpoint()
         snapshot.rebuild_transfer_bloom(self.state_machine)
         tracer.count("mark.block_sync_done")
+        tracer.count("recovery.sync_complete")
         log.info(
             "replica %d: block sync complete (%d blocks fetched)",
             self.replica, fetched,
@@ -2550,6 +2687,8 @@ class Replica:
         # staged ops were read from: drain execution first (they are
         # committed — at or below the new view's commit floor).
         self._quiesce_commit_stage()
+        if self._recovery_active and self.status != STATUS_NORMAL:
+            tracer.count("recovery.view_adopt")
         self.view = v
         self.log_view = v
         self.status = STATUS_NORMAL

@@ -2,9 +2,11 @@
 latest recorded round benchmark (BENCH_r*.json) and fail on a >10%
 regression in the e2e metrics (accepted throughput, client-perceived
 p50/p99, the lifecycle queue-wait/service totals) or the LSM store
-metrics (config5 ingest / major-compaction rates). Lifecycle metrics
-absent from a pre-lifecycle baseline are n/a, not failures; occupancy
-is recorded but not gated (throughput × latency has no monotone-good
+metrics (config5 ingest / major-compaction rates), or the recovery-time
+objectives (per-scenario recovery_time_s / degraded_throughput_pct from
+the chaos-at-load section — docs/CHAOS.md). Lifecycle/recovery metrics
+absent from an older baseline are n/a, not failures; occupancy is
+recorded but not gated (throughput × latency has no monotone-good
 direction).
 Steady-state jit compile counts (`steady_compiles`, recorded per device
 workload by bench.py via the tidy compile registry) are gated EXACTLY:
@@ -65,7 +67,35 @@ GATED = (
     ("end_to_end", "service_total_p50_ms", False),
     ("config5_lsm", "ingest_rows_per_s", True),
     ("config5_lsm", "major_compaction_rows_per_s", True),
+    # Recovery-time objectives (bench.py `recovery` section: the chaos
+    # scenarios of testing/chaos.py, docs/CHAOS.md). Keys are dotted
+    # paths into the per-scenario blocks. Lower is better for both: how
+    # long until the cluster is whole again, and what fraction of
+    # baseline throughput was lost while it recovered. replay_ops_per_s
+    # is recorded but NOT gated (a torn crash can legitimately replay 0
+    # WAL ops, and catch-up rate scales with how far behind the fault
+    # left the replica — no stable baseline). Absent from pre-recovery
+    # BENCH_r*.json baselines: n/a, not failure.
+    ("recovery", "kill_restart.recovery_time_s", False),
+    ("recovery", "kill_restart.degraded_throughput_pct", False),
+    ("recovery", "state_sync.recovery_time_s", False),
+    ("recovery", "state_sync.degraded_throughput_pct", False),
+    ("recovery", "grid_storm.recovery_time_s", False),
+    ("recovery", "grid_storm.degraded_throughput_pct", False),
+    ("recovery", "torn_checkpoint.recovery_time_s", False),
+    ("recovery", "torn_checkpoint.degraded_throughput_pct", False),
 )
+
+
+def lookup(section: dict, key: str):
+    """Resolve a possibly-dotted key ("kill_restart.recovery_time_s")
+    inside a section block; None when any path element is absent."""
+    cur = section
+    for part in key.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
 
 GATED_EXACT = (
     # (section, key): must EQUAL the baselined value. Steady-state jit
@@ -133,7 +163,7 @@ def main(argv=None) -> int:
         src = f"BENCH_r{rnd:02d}.json" if baseline is not None else "(no baseline)"
         print(f"gated metrics (baseline: {src}):")
         for section, key, higher in GATED:
-            base = (baseline or {}).get(section, {}).get(key)
+            base = lookup((baseline or {}).get(section) or {}, key)
             rule = ("≥ baseline × 0.90" if higher else "≤ baseline × 1.10")
             base_s = f"{float(base):,.1f}" if base is not None else "—"
             print(f"  {section}.{key:32s} {rule:22s} baseline={base_s}")
@@ -175,12 +205,14 @@ def main(argv=None) -> int:
         cur_sec = current.get(section) or {}
         base_sec = baseline.get(section) or {}
         label = f"{section}.{key}"
-        if key not in cur_sec:
+        cur_raw = lookup(cur_sec, key)
+        base_raw = lookup(base_sec, key)
+        if cur_raw is None:
             # A section the current run skipped/errored FAILS the gate
             # whenever the baseline recorded it (a crashed bench must
             # not pass as "no regression"); when the baseline never
             # recorded it either, there is nothing to compare (n/a).
-            base = float(base_sec[key]) if key in base_sec else None
+            base = float(base_raw) if base_raw is not None else None
             if base is not None:
                 failed.append(label)
             rows.append((
@@ -189,8 +221,8 @@ def main(argv=None) -> int:
                 if base is not None else "n/a",
             ))
             continue
-        cur = float(cur_sec[key])
-        base = float(base_sec[key]) if key in base_sec else None
+        cur = float(cur_raw)
+        base = float(base_raw) if base_raw is not None else None
         verdict = "n/a"
         if base is not None and base > 0:
             if higher_better:
@@ -243,11 +275,11 @@ def main(argv=None) -> int:
             "extra": {
                 "baseline_round": rnd,
                 "current": {
-                    f"{s}.{k}": (current.get(s) or {}).get(k)
+                    f"{s}.{k}": lookup(current.get(s) or {}, k)
                     for s, k in [(s, k) for s, k, _ in GATED] + list(GATED_EXACT)
                 },
                 "baseline": {
-                    f"{s}.{k}": (baseline.get(s) or {}).get(k)
+                    f"{s}.{k}": lookup(baseline.get(s) or {}, k)
                     for s, k in [(s, k) for s, k, _ in GATED] + list(GATED_EXACT)
                 },
                 "failed": failed,
