@@ -640,6 +640,33 @@ def bench_config5_lsm():
     jax.block_until_ready((ok, ov))
     dt = (time.perf_counter() - t0) / reps
     out["device_merge_tiled_rows_per_s"] = round(2 * m / dt, 1)
+
+    # Host k-way flush merge (ops/merge.merge_host_kway, the device
+    # query-index pipeline's CPU substrate): stable galloping merge of 8
+    # sorted runs vs the fused radix re-sort of their concatenation —
+    # both byte-identical by construction; recorded, not gated.
+    from tigerbeetle_tpu.lsm.store import KEY_DTYPE, sort_kv
+    from tigerbeetle_tpu.ops.merge import merge_host_kway
+
+    runs = 8
+    per = 1 << 15
+    parts_k, parts_v = [], []
+    for r in range(runs):
+        k = np.zeros(per, dtype=KEY_DTYPE)
+        # dup-heavy lo (the secondary-index shape): few distinct prefixes
+        k["lo"] = np.sort(rng.integers(0, 64, per).astype(np.uint64) << np.uint64(56))
+        k["hi"] = np.arange(per, dtype=np.uint64)
+        parts_k.append(k)
+        parts_v.append(np.arange(per, dtype=np.uint32))
+    t0 = time.perf_counter()
+    mk, mv = merge_host_kway(parts_k, parts_v)
+    t_merge = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    sk, sv = sort_kv(np.concatenate(parts_k), np.concatenate(parts_v))
+    t_sort = time.perf_counter() - t0
+    assert mk.tobytes() == sk.tobytes() and mv.tobytes() == sv.tobytes()
+    out["kway_merge_rows_per_s"] = round(runs * per / max(t_merge, 1e-9), 1)
+    out["kway_vs_radix_speedup"] = round(t_sort / max(t_merge, 1e-9), 2)
     return out
 
 

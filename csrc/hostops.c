@@ -297,6 +297,71 @@ int hostops_sort_kv(
     return 0;
 }
 
+/* Stable k-way merge of lo-major sorted (16-byte key, u32 value) runs:
+ * the flush/compaction fold for runs that are ALREADY sorted (insert-time
+ * sorted memtable batches, compaction chunk streams). Equal-lo keys drain
+ * the EARLIEST run first and keep within-run order — exactly the order a
+ * stable sort of the runs' concatenation produces, so output bytes are
+ * identical to hostops_sort_kv on the concatenated input (byte-equality
+ * is property-tested from Python).
+ *
+ * Selection gallops: after picking the earliest minimal run r, its whole
+ * prefix strictly below (or tying, when r precedes the tying run) the
+ * best other head is block-copied — pre-sorted and dup-heavy inputs then
+ * cost ~memcpy instead of a per-row heap. runs_keys rows are KEY_DTYPE
+ * (hi u64 first, lo u64 second). */
+int hostops_merge_kv(
+    int64_t k, const uint64_t **runs_keys, const uint32_t **runs_vals,
+    const int64_t *ns, uint64_t *keys_out, uint32_t *vals_out
+) {
+    if (k <= 0) return 0;
+    int64_t idx[64];
+    if (k > 64) return -1;
+    for (int64_t r = 0; r < k; r++) idx[r] = 0;
+    int64_t out = 0;
+    for (;;) {
+        /* Earliest run with the minimal head lo. */
+        int64_t r = -1;
+        uint64_t m = 0;
+        for (int64_t i = 0; i < k; i++) {
+            if (idx[i] >= ns[i]) continue;
+            uint64_t lo = runs_keys[i][2 * idx[i] + 1];
+            if (r < 0 || lo < m) { r = i; m = lo; }
+        }
+        if (r < 0) break;
+        /* Best head among the OTHER live runs (earliest on ties). */
+        int64_t r2 = -1;
+        uint64_t m2 = 0;
+        for (int64_t i = 0; i < k; i++) {
+            if (i == r || idx[i] >= ns[i]) continue;
+            uint64_t lo = runs_keys[i][2 * idx[i] + 1];
+            if (r2 < 0 || lo < m2) { r2 = i; m2 = lo; }
+        }
+        int64_t j = idx[r];
+        int64_t end = ns[r];
+        if (r2 >= 0) {
+            /* Take r's prefix while its key precedes every other head:
+             * strictly smaller lo, or a tie with a LATER run (stability:
+             * the earlier run's equal keys all come first). */
+            if (r < r2) {
+                while (j < end && runs_keys[r][2 * j + 1] <= m2) j++;
+            } else {
+                while (j < end && runs_keys[r][2 * j + 1] < m2) j++;
+            }
+        } else {
+            j = end; /* last live run: drain it */
+        }
+        int64_t cnt = j - idx[r];
+        memcpy(keys_out + 2 * out, runs_keys[r] + 2 * idx[r],
+               (size_t)cnt * 16);
+        memcpy(vals_out + out, runs_vals[r] + idx[r],
+               (size_t)cnt * sizeof(uint32_t));
+        out += cnt;
+        idx[r] = j;
+    }
+    return 0;
+}
+
 /* ------------------------------------------------- fast-path staging */
 
 /* One pass over raw 128-byte wire Transfer records doing everything the

@@ -150,9 +150,13 @@ class TestDurableIndex:
         assert (idx2.lookup_batch(q) == vals[::17]).all()
         assert idx2.count == idx.count
 
-    def test_device_and_host_compaction_same_tables(self):
+    def test_device_and_host_compaction_same_tables(self, monkeypatch):
         """The north-star bar: compaction through the device merge kernel
-        produces byte-identical table contents to the host merge."""
+        produces byte-identical table contents to the host merge. The
+        device route is FORCED (device_merge_pays() is false on CPU-only
+        backends since the query-index pipeline's routing policy) so the
+        kernel path stays exercised here."""
+        monkeypatch.setenv("TIGERBEETLE_TPU_DEVICE_MERGE", "1")
         _, idx_h, lo, hi, vals = self._rand_index(backend="numpy")
         _, idx_d, _, _, _ = self._rand_index(backend="jax")
 

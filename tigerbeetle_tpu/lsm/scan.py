@@ -48,6 +48,40 @@ TAG_TIMEOUT = 8
 TAG_LEDGER = 9
 TAG_CODE = 10
 
+# The QueryFilter-queryable fields, in INSERT ORDER — ascending by tag,
+# which makes the 5-block composite-key build block-ordered by key.lo
+# (tag is the top byte). Single source for the host key build
+# (state_machine._store_query_index) and the fused device kernel
+# (ops/qindex.py): (tag, lo-word field, hi-word field or None).
+QUERY_TAG_FIELDS = (
+    (TAG_UD128, "user_data_128_lo", "user_data_128_hi"),
+    (TAG_UD64, "user_data_64", None),
+    (TAG_UD32, "user_data_32", None),
+    (TAG_LEDGER, "ledger", None),
+    (TAG_CODE, "code", None),
+)
+
+
+def query_columns_constant(recs: np.ndarray) -> bool:
+    """True when every queryable column is constant across the batch —
+    the low-cardinality common case (fixed ledger/code, unset user_data).
+    Each tag block's fold56 image is then one repeated value, so the
+    5-block composite-key build is ALREADY lo-major sorted (blocks ascend
+    by tag, ties keep insertion order): the memtable can take the batch
+    as a sorted run and flush through the k-way merge instead of the
+    radix sort."""
+    if len(recs) <= 1:
+        return True
+    for _tag, f_lo, f_hi in QUERY_TAG_FIELDS:
+        col = recs[f_lo]
+        if bool((col != col[0]).any()):
+            return False
+        if f_hi is not None:
+            col = recs[f_hi]
+            if bool((col != col[0]).any()):
+                return False
+    return True
+
 
 def fold56(lo, hi=None) -> np.ndarray:
     """Fold a u64 (or u128 as lo/hi pair) to 56 bits, vectorized.

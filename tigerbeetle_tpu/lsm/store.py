@@ -77,6 +77,67 @@ def sort_kv(keys: np.ndarray, vals: np.ndarray):
     return keys[order], np.asarray(vals, dtype=np.uint32)[order]
 
 
+def merge_host_kway(parts_k, parts_v):
+    """Stable k-way merge of lo-major SORTED KEY_DTYPE runs on the host:
+    equal-lo keys drain earlier runs first (callers pass oldest-first),
+    within-run order preserved — byte-identical to sort_kv on the runs'
+    concatenation, at merge cost instead of radix cost. C shim
+    (hostops_merge_kv) with a sort_kv fallback; inputs beyond the shim's
+    64-run bound fold in groups. Jax-free on purpose: this is the
+    numpy-backend flush/compaction substrate (ops/merge.py re-exports
+    it for the device-pipeline callers)."""
+    parts = [(k, v) for k, v in zip(parts_k, parts_v) if len(k)]
+    if not parts:
+        if not len(parts_k):
+            return np.empty(0, dtype=KEY_DTYPE), np.empty(0, dtype=np.uint32)
+        return parts_k[0][:0], np.asarray(parts_v[0][:0], dtype=np.uint32)
+    if len(parts) == 1:
+        return parts[0][0], np.asarray(parts[0][1], dtype=np.uint32)
+    lib = _hostops()
+    if lib is None or not hasattr(lib, "hostops_merge_kv"):
+        return sort_kv(
+            np.concatenate([k for k, _ in parts]),
+            np.concatenate([v for _, v in parts]),
+        )
+    import ctypes
+
+    def merge_c(group):
+        k = len(group)
+        total = sum(len(pk) for pk, _ in group)
+        keys_c = [np.ascontiguousarray(pk) for pk, _ in group]
+        vals_c = [
+            np.ascontiguousarray(pv, dtype=np.uint32) for _, pv in group
+        ]
+        kp = (ctypes.c_void_p * k)(*[a.ctypes.data for a in keys_c])
+        vp = (ctypes.c_void_p * k)(*[a.ctypes.data for a in vals_c])
+        ns = (ctypes.c_int64 * k)(*[len(a) for a in keys_c])
+        out_k = np.empty(total, dtype=keys_c[0].dtype)
+        out_v = np.empty(total, dtype=np.uint32)
+        rc = lib.hostops_merge_kv(
+            k, kp, vp, ns,
+            out_k.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            out_v.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        )
+        if rc != 0:
+            return sort_kv(
+                np.concatenate([pk for pk, _ in group]),
+                np.concatenate([pv for _, pv in group]),
+            )
+        return out_k, out_v
+
+    # Fold in groups of ≤8: head selection scans the live heads linearly,
+    # so wide merges pay k compares per row — two narrow passes beat one
+    # wide one well before the shim's 64-run bound. Grouping consecutive
+    # runs preserves the oldest-first stability order.
+    while len(parts) > 8:
+        parts = [
+            merge_c(parts[g : g + 8]) if len(parts[g : g + 8]) > 1
+            else parts[g]
+            for g in range(0, len(parts), 8)
+        ]
+    return merge_c(parts)
+
+
 def sort_lo_major(keys: np.ndarray) -> np.ndarray:
     """Stable argsort by the lo column (ties keep insertion order)."""
     lib = _hostops()

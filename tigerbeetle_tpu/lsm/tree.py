@@ -37,6 +37,7 @@ from tigerbeetle_tpu.lsm.store import (
     KEY_DTYPE,
     NOT_FOUND,
     Bloom,
+    merge_host_kway,
     search_run,
     sort_kv,
     sort_lo_major,
@@ -196,6 +197,7 @@ class DurableIndex:
         growth: int = 8,
         backend: str = "numpy",
         name: Optional[str] = None,
+        merge_hint: Optional[str] = None,
     ) -> None:
         self.grid = grid
         self.unique = unique
@@ -206,6 +208,14 @@ class DurableIndex:
         self.memtable_max = memtable_max
         self.growth = growth
         self.backend = backend
+        # merge_hint="dups": the tree's keys are known low-cardinality
+        # (secondary indexes over ledger/code-class fields), where the
+        # galloping k-way merge block-copies duplicate runs (~30x the
+        # radix) — route every sorted fold through it regardless of run
+        # count. Without the hint the k-way merge is used only for ≤ 8
+        # runs (head selection is linear in k; wide random merges lose
+        # to one radix pass).
+        self.merge_hint = merge_hint
         # Memtable batches: appended in the store context, read drain-free
         # from the commit thread under the flag-before-batch publish order
         # (_sort_mem_lazily) — never concurrently mutated from both.
@@ -289,6 +299,74 @@ class DurableIndex:
         if self._mem_count >= self.memtable_max:
             self.flush_memtable()
 
+    def insert_run_lazy(self, run) -> None:
+        """Append a DISPATCHED device run (ops/qindex.QueryKeyRun): a
+        handle whose keys live on the device until `materialize()` — the
+        split-phase write path of the device query-index pipeline. The
+        run counts toward the flush threshold immediately (flush cadence,
+        hence grid allocation order, is identical to the host path); its
+        bytes are only demanded at flush, a read, or the store stage's
+        idle prefetch. Only ever used for store-barrier-synchronized
+        trees (query_rows) — never for the drain-free-read transfer-id
+        index, whose readers cannot tolerate in-place resolution."""
+        if run.n == 0:
+            return
+        self._mem_sorted.append(run.sorted)
+        self._mem.append(run)
+        self._mem_count += run.n
+        self.count += run.n
+        if self._mem_count >= self.memtable_max:
+            self.flush_memtable()
+
+    def _resolve_mem(self) -> None:
+        """Materialize any lazy device runs in place (tuples stay).
+        Mutation is store-context-owned like every memtable write; read
+        paths reach here only behind a store barrier."""
+        mem = self._mem
+        for i in range(len(mem)):
+            if not isinstance(mem[i], tuple):
+                mem[i] = mem[i].materialize()
+
+    def prefetch_lazy_one(self) -> bool:
+        """Materialize ONE pending device run (oldest first) — the store
+        stage's idle poll: the device→host transfer is pulled forward
+        into queue-idle gaps so the eventual flush never blocks on the
+        device. Content and flush timing are unchanged (materialize is
+        idempotent); True while more runs remain.
+
+        The poll pulls exactly when the flush's device fold will NOT
+        run (fold precondition: every batch an unmaterialized lazy run,
+        and the device merge pays). While the fold is intact, an early
+        per-run transfer would waste d2h bandwidth AND devolve the fold
+        to the host path, making its kernel shapes — hence the
+        compile-count gate — timing-dependent, so the poll keeps its
+        hands off. Once a read barrier has materialized ANY run
+        (lookup_range → _resolve_mem) the cycle is host-bound either
+        way and pulling the rest forward is pure win; barrier timing is
+        op-stream-driven (deterministic across replicas), so the
+        fold-vs-host routing stays deterministic too.
+
+        The pending scan runs FIRST so the numpy backend (never any
+        lazy runs) returns without touching ops.merge — importing it
+        pulls in jax (~1s), which must never happen on the store thread
+        of a numpy-backend server mid-load."""
+        pending = []
+        fold_intact = True
+        for m in self._mem:
+            if isinstance(m, tuple) or m.materialized:
+                fold_intact = False
+            else:
+                pending.append(m)
+        if not pending:
+            return False
+        if fold_intact:
+            from tigerbeetle_tpu.ops import merge as merge_ops
+
+            if merge_ops.device_merge_pays():
+                return False
+        pending[0].materialize()
+        return len(pending) > 1
+
     def _sort_mem_lazily(self) -> None:
         """Point-lookup prerequisite: every memtable batch lo-major sorted
         (unsorted ones arrive via insert_unsorted). Operates on local
@@ -299,6 +377,7 @@ class DurableIndex:
         mutation loop, and the drain-free concurrent reader cannot race
         the store thread's appends (unsorted-batch trees are only ever
         read behind a full store barrier)."""
+        self._resolve_mem()  # no-op unless lazy device runs are present
         flags = self._mem_sorted
         mem = self._mem
         if len(flags) >= len(mem) and all(flags):
@@ -324,16 +403,84 @@ class DurableIndex:
         harmless for point lookups (same key → same value)."""
         if self._mem_count == 0:
             return
-        keys = np.concatenate([k for k, _ in self._mem])
-        vals = np.concatenate([v for _, v in self._mem])
-        keys, vals = sort_kv(keys, vals)  # fused C sort+gather
-        table = self._build_table(keys, vals)
+        keys, vals = self._flush_sorted_kv()
+        with self._flush_span("build"):
+            table = self._build_table(keys, vals)
         self.levels[0].append(table)
         self._mem = []
         self._mem_sorted = []
         self._mem_count = 0
         tracer.count("lsm.memtable_flushes")
         self._publish_level_gauges()
+
+    def _flush_span(self, phase: str):
+        """Flush-phase span for named trees (`lsm.<name>.flush.<phase>`)
+        — profile_e2e splits the query tree's store row on these."""
+        if self.name is None or not tracer.enabled():
+            return tracer.null_span()
+        return tracer.span(f"lsm.{self.name}.flush.{phase}")
+
+    def _flush_sorted_kv(self) -> Tuple[np.ndarray, np.ndarray]:
+        """The memtable as ONE lo-major stable-sorted (keys, vals) run.
+
+        Route by what the batches already are: when every batch is a
+        sorted run, a stable k-way MERGE (oldest first — identical bytes
+        to the radix sort of the concatenation, enforced by property
+        tests) replaces the full re-sort; all-device sorted runs fold
+        through the tiled merge kernel and materialize only here, at the
+        table-build boundary. Unsorted batches (insert_unsorted trees)
+        keep the fused C radix path.
+
+        ops.merge (which imports jax) is only touched on the lazy-run
+        branch — lazy runs exist only on the jax backend, so the numpy
+        flush stays jax-import-free."""
+        mem = self._mem
+        flags = self._mem_sorted
+        all_sorted = len(flags) >= len(mem) and all(flags)
+        if all_sorted and len(mem) == 1:
+            self._resolve_mem()
+            return mem[0]
+        if all_sorted and len(mem) > 1:
+            lazy = [m for m in mem if not isinstance(m, tuple)]
+            if lazy and len(lazy) == len(mem):
+                from tigerbeetle_tpu.ops import merge as merge_ops
+
+                if (
+                    not any(r.materialized for r in lazy)
+                    and merge_ops.device_merge_pays()
+                ):
+                    # Device-resident fold: sorted device runs merge
+                    # on-chip; the one sync below is the sanctioned
+                    # table-build boundary (pads sort last, stripped by
+                    # the real count).
+                    from tigerbeetle_tpu.ops import qindex
+
+                    with self._flush_span("merge"):
+                        t_disp = tracer.device_dispatch("merge_kernel_tiled")
+                        kd, pd, n_real = qindex.fold_runs_device(lazy)
+                        keys, vals = qindex.materialize_fold(kd, pd, n_real)
+                        tracer.device_finish(
+                            "merge_kernel_tiled", t_disp,
+                            d2h_bytes=keys.nbytes + vals.nbytes,
+                        )
+                        # The fold consumed the runs on-chip: close each
+                        # run's key-build dispatch token here, at the one
+                        # sync, so device.step.<key-build entry> reports
+                        # on the primary path too.
+                        for r in lazy:
+                            r.finish_dispatch()
+                    return keys, vals
+            self._resolve_mem()
+            if self.merge_hint == "dups" or len(mem) <= 8:
+                with self._flush_span("merge"):
+                    return merge_host_kway(
+                        [k for k, _ in mem], [v for _, v in mem]
+                    )
+        self._resolve_mem()
+        with self._flush_span("sort"):
+            keys = np.concatenate([k for k, _ in self._mem])
+            vals = np.concatenate([v for _, v in self._mem])
+            return sort_kv(keys, vals)  # fused C sort+gather
 
     def _publish_level_gauges(self) -> None:
         if self.name is not None and tracer.enabled():
@@ -510,11 +657,13 @@ class DurableIndex:
             pass
 
     def _merge_chunk(self, ka, va, kb, vb) -> Tuple[np.ndarray, np.ndarray]:
-        from tigerbeetle_tpu.ops import merge as merge_ops
-
+        # ops.merge only on the jax backend (importing it pulls in jax).
         if self.backend == "jax":
-            return merge_ops.merge_device(ka, va, kb, vb)
-        return merge_ops.merge_host(ka, va, kb, vb)
+            from tigerbeetle_tpu.ops import merge as merge_ops
+
+            if merge_ops.device_merge_pays():
+                return merge_ops.merge_device(ka, va, kb, vb)
+        return merge_host_kway([ka, kb], [va, vb])
 
     def _merge_tables(
         self, tables_a: List[TableInfo], tables_b: List[TableInfo]
@@ -770,6 +919,7 @@ class DurableIndex:
     def lookup_range(self, key: np.void) -> np.ndarray:
         """All values stored under `key` (non-unique index), ascending."""
         assert not self.unique
+        self._resolve_mem()
         k_lo = key["lo"]
         k_hi = key["hi"]
         parts: List[np.ndarray] = []
@@ -1048,16 +1198,20 @@ class _CompactionJob:
         if len(parts_k) == 1:
             return parts_k[0], parts_v[0]
         if self.tree.backend == "jax":
-            # Chip-colocated hosts fold the chunk through the device
-            # merge-path kernel (ops/merge.py) pairwise — each part is
-            # sorted, and the fold keeps older parts on the A side.
-            mk, mv = parts_k[0], parts_v[0]
-            for k, v in zip(parts_k[1:], parts_v[1:]):
-                mk, mv = self.tree._merge_chunk(mk, mv, k, v)
-            return mk, mv
-        # Host path: concatenate oldest-first + fused stable radix
-        # sort+gather (one C call; byte-identical to argsort + gather).
-        return sort_kv(np.concatenate(parts_k), np.concatenate(parts_v))
+            from tigerbeetle_tpu.ops import merge as merge_ops
+
+            if merge_ops.device_merge_pays():
+                # Chip-colocated hosts fold the chunk through the device
+                # merge-path kernel (ops/merge.py) pairwise — each part is
+                # sorted, and the fold keeps older parts on the A side.
+                mk, mv = parts_k[0], parts_v[0]
+                for k, v in zip(parts_k[1:], parts_v[1:]):
+                    mk, mv = self.tree._merge_chunk(mk, mv, k, v)
+                return mk, mv
+        # Host path: each part is sorted and parts arrive oldest-first,
+        # so the stable galloping k-way merge (C shim) produces the
+        # radix sort's exact bytes at merge cost instead of sort cost.
+        return merge_host_kway(parts_k, parts_v)
 
 
 class _TableWriter:

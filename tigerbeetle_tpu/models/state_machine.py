@@ -239,11 +239,26 @@ class StateMachine:
         # timestamp) -> row, for the 8 indexed transfer fields beyond
         # id/dr/cr (reference: one LSM tree per field,
         # state_machine.zig:198-219; see lsm/scan.py for the re-shape).
+        # merge_hint="dups": the composite keys are low-cardinality by
+        # construction (5 tag blocks over mostly-constant columns), which
+        # is the galloping k-way merge's best case at flush.
         self.query_rows = DurableIndex(
             self.grid, unique=False,
             memtable_max=config.index_memtable_rows, backend=backend,
-            name="query_rows",
+            name="query_rows", merge_hint="dups",
         )
+        # Device query-index pipeline (ops/qindex.py): key build + run
+        # merge on the device, lazy host materialization. Only where the
+        # device path pays (accelerator backends; TIGERBEETLE_TPU_DEVICE_MERGE
+        # forces either way) — the numpy/CPU fallback keeps the host block
+        # in _store_query_index, byte-identical by the qindex property
+        # tests.
+        if backend == "jax":
+            from tigerbeetle_tpu.ops.merge import device_merge_pays
+
+            self._qindex_device = device_merge_pays()
+        else:
+            self._qindex_device = False
         self.transfer_log = DurableLog(self.grid, types.TRANSFER_DTYPE)
         # Transfer-id membership pre-filter (no false negatives): keeps the
         # per-batch duplicate-id check O(batch) instead of O(tables).
@@ -426,27 +441,56 @@ class StateMachine:
                 np.asarray(ts, dtype=np.uint64)
                 if ts is not None else recs["timestamp"]
             )
-            # One preallocated key block filled slice-wise (identical
-            # bytes to the old per-tag build + concatenate, minus the
-            # five temporaries and the 5n-row copy on the commit path).
-            tags = (
-                (scan.TAG_UD128, scan.fold56(
-                    recs["user_data_128_lo"], recs["user_data_128_hi"]
-                )),
-                (scan.TAG_UD64, scan.fold56(recs["user_data_64"])),
-                (scan.TAG_UD32, scan.fold56(recs["user_data_32"])),
-                (scan.TAG_LEDGER, scan.fold56(recs["ledger"])),
-                (scan.TAG_CODE, scan.fold56(recs["code"])),
-            )
-            n = len(recs)
-            keys = np.empty(len(tags) * n, dtype=scan.KEY_DTYPE)
-            klo, khi = keys["lo"], keys["hi"]
-            for i, (tag, folded) in enumerate(tags):
-                klo[i * n : (i + 1) * n] = (
-                    np.uint64(tag) << np.uint64(56)
-                ) | folded
-                khi[i * n : (i + 1) * n] = tstamp
-            self.query_rows.insert_unsorted(keys, np.tile(rows, len(tags)))
+            if self._qindex_device:
+                # Device pipeline: stage + dispatch the fused key-build
+                # kernel and hand the tree a LAZY run handle — no
+                # device→host sync here, so batch N+1's key build
+                # overlaps batch N's merge drain (split-phase, the
+                # commit kernel's discipline). Bytes are demanded at
+                # flush (device fold for sorted runs), a read barrier,
+                # or the store stage's idle prefetch.
+                from tigerbeetle_tpu.ops import qindex
+
+                with tracer.span("sm.store.query.keys"):
+                    run = qindex.build_run(recs, rows, tstamp)
+                self.query_rows.insert_run_lazy(run)
+                return
+            # Host fallback: one preallocated key block filled slice-wise
+            # (identical bytes to the old per-tag build + concatenate,
+            # minus the five temporaries and the 5n-row copy on the
+            # commit path).
+            with tracer.span("sm.store.query.keys"):
+                tags = (
+                    (scan.TAG_UD128, scan.fold56(
+                        recs["user_data_128_lo"], recs["user_data_128_hi"]
+                    )),
+                    (scan.TAG_UD64, scan.fold56(recs["user_data_64"])),
+                    (scan.TAG_UD32, scan.fold56(recs["user_data_32"])),
+                    (scan.TAG_LEDGER, scan.fold56(recs["ledger"])),
+                    (scan.TAG_CODE, scan.fold56(recs["code"])),
+                )
+                n = len(recs)
+                keys = np.empty(len(tags) * n, dtype=scan.KEY_DTYPE)
+                klo, khi = keys["lo"], keys["hi"]
+                for i, (tag, folded) in enumerate(tags):
+                    klo[i * n : (i + 1) * n] = (
+                        np.uint64(tag) << np.uint64(56)
+                    ) | folded
+                    khi[i * n : (i + 1) * n] = tstamp
+                vals = np.tile(rows, len(tags))
+            if scan.query_columns_constant(recs):
+                # Constant queryable columns (fixed ledger/code, unset
+                # user_data — the common ingest shape): each tag block
+                # holds ONE repeated lo, blocks ascend by tag, so the
+                # batch is already lo-major sorted in insertion order.
+                # Flagging it sorted routes the flush through the
+                # galloping k-way merge (≈ memcpy on dup runs) instead
+                # of the full radix re-sort — identical bytes (stable
+                # merge of per-batch stable order == stable sort of the
+                # concatenation, property-tested).
+                self.query_rows.insert_sorted(keys, vals)
+            else:
+                self.query_rows.insert_unsorted(keys, vals)
 
     def _store_native(self, recs: np.ndarray, row_base: int) -> bool:
         """C-fused index staging (hostops_build_sorted_kv): builds the

@@ -133,6 +133,15 @@ def hostops() -> Optional[ctypes.CDLL]:
     if hasattr(lib, "hostops_sort_kv"):
         lib.hostops_sort_kv.argtypes = [ctypes.c_int64, u64p, u32p, u64p, u32p]
         lib.hostops_sort_kv.restype = ctypes.c_int
+    # Stable k-way merge of sorted runs (round-13 device query-index
+    # pipeline's host merge substrate). Same stale-.so guard as above.
+    if hasattr(lib, "hostops_merge_kv"):
+        lib.hostops_merge_kv.argtypes = [
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_int64), u64p, u32p,
+        ]
+        lib.hostops_merge_kv.restype = ctypes.c_int
     # The C staging ladder hardcodes the wire-contract result codes; refuse
     # the shim (fall back to numpy) if the enums ever drift.
     from tigerbeetle_tpu.results import CreateTransferResult as _TR

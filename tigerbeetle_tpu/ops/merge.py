@@ -85,6 +85,19 @@ def merge_kernel(keys_a, vals_a, keys_b, vals_b):
 
 
 MERGE_TILE = 256
+# The bucket-floor logic below (bucket_pow2) relies on every pow-2
+# bucket ≥ the tile being a tile MULTIPLE — true only for pow-2 tiles.
+assert MERGE_TILE & (MERGE_TILE - 1) == 0
+
+
+def bucket_pow2(n: int) -> int:
+    """Power-of-two bucket ≥ MERGE_TILE for an n-row run: the kernels
+    compile once per bucket AND every bucket is tile-aligned, so the
+    tiled merge-path kernel runs for every input size. The single source
+    for _pad_pow2 and qindex.stage_query_batch — one retune point."""
+    return 1 << max(
+        (MERGE_TILE - 1).bit_length(), (max(n, 1) - 1).bit_length()
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("tile",))
@@ -189,11 +202,15 @@ def merge_kernel_tiled(keys_a, vals_a, keys_b, vals_b, tile: int = MERGE_TILE):
 
 
 def _pad_pow2(keys: np.ndarray, vals: np.ndarray):
-    """Pad to the next power-of-two bucket so the kernel compiles once per
-    bucket size. Pad rows set the pad-flag limb (last key column) to 1,
-    which sorts strictly after every real key."""
+    """Pad to the next power-of-two bucket ≥ MERGE_TILE so the kernel
+    compiles once per bucket size AND every bucket is tile-aligned: any
+    pow-2 ≥ the tile is a tile multiple, so the tiled merge-path kernel
+    always runs (runs under 256 rows used to miss the n % tile == 0 gate
+    and silently fall back to the slow global-binary-search kernel). Pad
+    rows set the pad-flag limb (last key column) to 1, which sorts
+    strictly after every real key."""
     n = len(keys)
-    n_pad = 1 << max(4, (max(n, 1) - 1).bit_length())
+    n_pad = bucket_pow2(n)
     if n == n_pad:
         return keys, vals
     pk = np.zeros((n_pad, keys.shape[1]), dtype=keys.dtype)
@@ -204,38 +221,69 @@ def _pad_pow2(keys: np.ndarray, vals: np.ndarray):
     return pk, pv
 
 
+def device_merge_pays() -> bool:
+    """Whether routing sorted-run merges through the device kernels pays
+    on this backend. XLA's CPU variadic sort/merge lowering is comparator-
+    driven (not vectorized) and loses to the host C radix/merge by >10x at
+    memtable sizes, so the device path is reserved for accelerator
+    backends; TIGERBEETLE_TPU_DEVICE_MERGE=1/0 overrides either way."""
+    import os
+
+    ov = os.environ.get("TIGERBEETLE_TPU_DEVICE_MERGE")  # tidy: allow=env-read — backend routing policy, fixed per process; both routes are byte-identical
+    if ov is not None:
+        return ov not in ("0", "false", "")
+    import jax
+
+    return jax.default_backend() != "cpu"
+
+
+def to_device_run(keys: np.ndarray, vals: np.ndarray):
+    """Host KEY_DTYPE run → padded device-format (keys (N, 3), payload
+    (N, 3)) u32 arrays: [lo0, lo1, pad] / [hi0, hi1, val]."""
+    n = len(keys)
+    k = np.zeros((n, 3), dtype=np.uint32)
+    k[:, 0] = keys["lo"] & 0xFFFFFFFF
+    k[:, 1] = keys["lo"] >> np.uint64(32)
+    p = np.zeros((n, 3), dtype=np.uint32)
+    p[:, 0] = keys["hi"] & 0xFFFFFFFF
+    p[:, 1] = keys["hi"] >> np.uint64(32)
+    p[:, 2] = vals
+    return _pad_pow2(k, p)
+
+
+def from_device_run(ok: np.ndarray, op: np.ndarray, n: int):
+    """Materialized device-format arrays → (KEY_DTYPE keys, u32 vals),
+    padding stripped (pads sort strictly last)."""
+    from tigerbeetle_tpu.lsm.store import KEY_DTYPE
+
+    ok = np.asarray(ok)[:n]
+    op = np.asarray(op)[:n]
+    out = np.empty(n, dtype=KEY_DTYPE)
+    out["lo"] = ok[:, 0].astype(np.uint64) | (ok[:, 1].astype(np.uint64) << 32)
+    out["hi"] = op[:, 0].astype(np.uint64) | (op[:, 1].astype(np.uint64) << 32)
+    return out, op[:, 2].copy()
+
+
 def merge_device(keys_a, vals_a, keys_b, vals_b):
     """Merge two lo-major-sorted structured KEY_DTYPE runs on device.
 
     Comparison key: (lo as 2 u32 limbs, pad flag). hi + value ride as a
-    (n, 3) u32 payload.
+    (n, 3) u32 payload. _pad_pow2 buckets are tile multiples, so the
+    tiled merge-path kernel runs for every input size.
     """
-    from tigerbeetle_tpu.lsm.store import KEY_DTYPE
-
-    def to_dev(keys, vals):
-        n = len(keys)
-        k = np.zeros((n, 3), dtype=np.uint32)
-        k[:, 0] = keys["lo"] & 0xFFFFFFFF
-        k[:, 1] = keys["lo"] >> np.uint64(32)
-        p = np.zeros((n, 3), dtype=np.uint32)
-        p[:, 0] = keys["hi"] & 0xFFFFFFFF
-        p[:, 1] = keys["hi"] >> np.uint64(32)
-        p[:, 2] = vals
-        return _pad_pow2(k, p)
-
     n, m = len(keys_a), len(keys_b)
-    ka, pa = to_dev(keys_a, vals_a)
-    kb, pb = to_dev(keys_b, vals_b)
-    if len(ka) % MERGE_TILE == 0 and len(kb) % MERGE_TILE == 0:
-        ok, op = merge_kernel_tiled(ka, pa, kb, pb)
-    else:
-        ok, op = merge_kernel(ka, pa, kb, pb)
-    ok = np.asarray(ok)[: n + m]
-    op = np.asarray(op)[: n + m]
-    out = np.empty(n + m, dtype=KEY_DTYPE)
-    out["lo"] = ok[:, 0].astype(np.uint64) | (ok[:, 1].astype(np.uint64) << 32)
-    out["hi"] = op[:, 0].astype(np.uint64) | (op[:, 1].astype(np.uint64) << 32)
-    return out, op[:, 2].copy()
+    ka, pa = to_device_run(keys_a, vals_a)
+    kb, pb = to_device_run(keys_b, vals_b)
+    ok, op = merge_kernel_tiled(ka, pa, kb, pb)
+    return from_device_run(ok, op, n + m)
+
+
+# Host-side stable k-way merge: lives in lsm/store.py (jax-free, next to
+# sort_kv and the C shim it wraps) so numpy-backend flush/compaction can
+# use it WITHOUT importing this module — importing ops.merge pulls in jax
+# (~1s), which must never happen mid-load on a numpy-backend server.
+# Re-exported here for the device-pipeline callers and the test suite.
+from tigerbeetle_tpu.lsm.store import merge_host_kway  # noqa: E402,F401
 
 
 def merge_host(keys_a, vals_a, keys_b, vals_b):

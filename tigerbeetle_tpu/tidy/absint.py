@@ -321,7 +321,8 @@ class _FnAnalysis:
         if isinstance(node, (ast.List, ast.Set)):
             return [self.eval(e) for e in node.elts]
         if isinstance(node, ast.Attribute):
-            if node.attr in ("shape", "dtype", "ndim", "size", "strides"):
+            if node.attr in ("shape", "dtype", "ndim", "size", "strides",
+                             "nbytes", "itemsize"):
                 return HOST_TOP
             if node.attr in ("T",):
                 return self.eval(node.value)

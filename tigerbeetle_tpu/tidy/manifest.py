@@ -88,6 +88,7 @@ JAXLINT_MODULES = (
     "tigerbeetle_tpu/ops/commit.py",
     "tigerbeetle_tpu/ops/commit_exact.py",
     "tigerbeetle_tpu/ops/merge.py",
+    "tigerbeetle_tpu/ops/qindex.py",
     "tigerbeetle_tpu/models/state_machine.py",
     "tigerbeetle_tpu/parallel/sharding.py",
     "tigerbeetle_tpu/parallel/sharded_ops.py",
@@ -105,6 +106,8 @@ JIT_ENTRIES = {
     "read_balances": (),
     "merge_kernel": (),
     "merge_kernel_tiled": ("tile",),
+    "query_index_keys": (),
+    "query_index_keys_sorted": (),
 }
 
 # (repo-relative file, qualified function) pairs forming the SANCTIONED
@@ -118,12 +121,19 @@ JAXLINT_SYNC_SEAM = frozenset((
     ("tigerbeetle_tpu/models/state_machine.py", "StateMachine._create_transfers_exact"),
     ("tigerbeetle_tpu/models/state_machine.py", "StateMachine._read_balances"),
     ("tigerbeetle_tpu/ops/merge.py", "merge_device"),
+    ("tigerbeetle_tpu/ops/merge.py", "from_device_run"),
+    # The device query-index pipeline's ONLY sync points: a lazy run's
+    # materialization (flush/read/idle-prefetch) and the device fold's
+    # table-build boundary (lsm/tree._flush_sorted_kv).
+    ("tigerbeetle_tpu/ops/qindex.py", "QueryKeyRun.materialize"),
+    ("tigerbeetle_tpu/ops/qindex.py", "materialize_fold"),
 ))
 
 # Functions whose results count as shape-stabilized (bucket-padded):
 # jit-entry arguments produced by these escape the retrace-shape rule.
 JAXLINT_PAD_HELPERS = frozenset((
     "_device_batch", "_pad_pow2", "_pad_slots", "pad1", "p1",
+    "stage_query_batch", "to_device_run",
 ))
 
 # --- absint: limb-width abstract interpretation scope --------------------
@@ -135,6 +145,10 @@ JAXLINT_PAD_HELPERS = frozenset((
 ABSINT_TARGETS = {
     "tigerbeetle_tpu/ops/u128.py": 32,
     "tigerbeetle_tpu/lsm/scan.py": 64,
+    # The fused device key build re-expresses fold56 + tag<<56 over u32
+    # limbs: every shift/or must stay in-width from the declared tag/f1
+    # ranges (ops/qindex._key_block).
+    "tigerbeetle_tpu/ops/qindex.py": 32,
 }
 
 # --- marker scan scope ---------------------------------------------------

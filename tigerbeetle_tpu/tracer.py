@@ -214,6 +214,12 @@ class _NullSpan:
 _NULL_SPAN = _NullSpan()
 
 
+def null_span() -> _NullSpan:
+    """The shared no-op span, for callers that decide span identity
+    themselves (e.g. anonymous trees skipping their flush-phase rows)."""
+    return _NULL_SPAN
+
+
 # --- control ------------------------------------------------------------
 
 
@@ -794,6 +800,20 @@ def lifecycle_summary() -> dict:
         flat[f"{key}_ms"] = s["mean_ms"]
         flat[f"{key}_p50_ms"] = s["p50_ms"]
         flat[f"{key}_p99_ms"] = s["p99_ms"]
+    # Store-stage hot rows, benchmark-gated (tools/bench_gate.py,
+    # lower-better): the per-batch cost of the secondary query-index
+    # build+flush (the device query-index pipeline's target row) and the
+    # commit thread's backpressure stall behind the store stage.
+    for event, key in (
+        ("sm.store.query", "store_query_ms_per_batch"),
+        ("pipeline.store.stall", "store_stall_ms_per_wait"),
+    ):
+        s = stats(event)
+        if s is None:
+            continue
+        flat[key] = s["mean_ms"]
+        flat[f"{key}_p50"] = s["p50_ms"]
+        flat[f"{key}_p99"] = s["p99_ms"]
     # Stage occupancy: mean prepares resident per pipeline stage (wait +
     # service of that stage), plus the whole arrive→reply window.
     occupancy.update(_stage_occupancy(

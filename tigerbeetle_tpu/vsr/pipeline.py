@@ -252,11 +252,19 @@ class StoreExecutor:
         post: Callable[[Callable[[], None]], None],
         notify: Optional[Callable[[], None]] = None,
         depth_max: int = DEPTH_MAX,
+        idle_work: Optional[Callable[[], bool]] = None,
     ) -> None:
         self._process = process
         self._post = post
         self._notify = notify if notify is not None else (lambda: None)
         self._depth_max = depth_max
+        # Optional queue-idle poll (device query-index pipeline): called
+        # with the lock RELEASED while the queue is empty; returns True
+        # while it may have more to do. Must be content-neutral and
+        # idempotent — it only pulls deferred device→host transfers
+        # forward (QueryKeyRun.materialize), never changes state bytes —
+        # so it needs no drain()/barrier coordination.
+        self._idle_work = idle_work
         self._cond = tidy_runtime.make_condition("store.cond")
         self._pending: deque = deque()  # tidy: guarded-by=_cond
         # tidy: atomic — GIL-atomic deque handoff: worker appends, loop pops
@@ -390,16 +398,35 @@ class StoreExecutor:
 
     def _run(self) -> None:
         tidy_runtime.stamp("store")
+        # Idle work stays armed while the last poll reported more pending
+        # (or a job just ran, which may have queued new lazy runs); once
+        # it reports dry the worker blocks on the condition until the
+        # next submit — no spinning.
+        idle_armed = self._idle_work is not None
         while True:
             with self._cond:
                 while (not self._pending or self._parked) and not self._stopped:
+                    if idle_armed and not self._parked:
+                        break  # poll outside the lock, then re-check
                     _timed_wait(self._cond, "pipeline.store.idle")
                 if self._stopped:
                     return
-                job = self._pending.popleft()
-                self._current = job
-                self._busy = True
-                self._cond.notify_all()  # submit()'s backpressure wait
+                if not self._pending or self._parked:
+                    job = None
+                else:
+                    job = self._pending.popleft()
+                    self._current = job
+                    self._busy = True
+                    self._cond.notify_all()  # submit()'s backpressure wait
+            if job is None:
+                try:
+                    with tracer.span("pipeline.store.prefetch"):
+                        idle_armed = bool(self._idle_work())
+                except Exception as e:  # noqa: BLE001 — fail-stop, never wedge
+                    self._poison(e)
+                    return
+                continue
+            idle_armed = self._idle_work is not None
             try:
                 publish = self._process(job)
             except Exception as e:  # noqa: BLE001 — fail-stop, never wedge

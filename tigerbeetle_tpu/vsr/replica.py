@@ -1387,8 +1387,18 @@ class Replica:
             process=self._store_process,
             post=post,
             notify=self._drain_store_faults,
+            idle_work=self._store_idle_prefetch,
         )
         self.state_machine.attach_store_stage(self.store_executor)
+
+    def _store_idle_prefetch(self) -> bool:
+        """Queue-idle poll on the store worker: pull ONE pending device
+        query-index run's device→host transfer forward (lsm/tree
+        prefetch_lazy_one) so the eventual flush never blocks on the
+        device. Content-neutral and idempotent — materialization is the
+        same bytes whenever it happens; `self.state_machine` is read per
+        call so a state-sync install is picked up naturally."""
+        return self.state_machine.query_rows.prefetch_lazy_one()
 
     def _store_process(self, job: dict) -> Optional[dict]:
         """Worker-thread side: apply one op's coalesced store job, then

@@ -65,6 +65,13 @@ GATED = (
     # its factors are already gated above.
     ("end_to_end", "queue_wait_total_p50_ms", False),
     ("end_to_end", "service_total_p50_ms", False),
+    # Store-stage hot row (device query-index pipeline, PR 8): mean
+    # per-batch cost of the secondary-index key build + memtable insert
+    # on the store thread, scraped from the registry's sm.store.query
+    # span via /lifecycle. Absent from pre-PR-8 baselines: n/a, not a
+    # failure. store_stall_ms_per_wait is recorded alongside but NOT
+    # gated (its count is wait events, not batches — load-shape noise).
+    ("end_to_end", "store_query_ms_per_batch", False),
     ("config5_lsm", "ingest_rows_per_s", True),
     ("config5_lsm", "major_compaction_rows_per_s", True),
     # Recovery-time objectives (bench.py `recovery` section: the chaos
