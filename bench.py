@@ -760,6 +760,22 @@ def bench_e2e():
     return med
 
 
+def bench_overload():
+    """Front-door overload objectives (docs/FRONT_DOOR.md): a real
+    `cli.py start` replica under the open-loop harness
+    (testing/loadgen.py) — saturation probe, accepted-vs-offered +
+    perceived p50/p99 at 1x/2x/5x the measured ceiling, then a
+    2000-session churn run (ramp-in, disconnect storm, identity
+    rotation, slow readers) ending in a durability/liveness audit.
+    Gated by tools/bench_gate.py (accepted_tx_per_s_at_1x,
+    perceived_p99_ms_at_1x); a crashed run records an error entry
+    WITHOUT the gated keys, which FAILS the gate against any baseline
+    that recorded them (fail-closed, like the recovery section)."""
+    from tigerbeetle_tpu.testing import loadgen
+
+    return loadgen.run_overload_bench()
+
+
 def bench_recovery():
     """Recovery-time objectives under chaos at load (docs/CHAOS.md): the
     four scenarios of testing/chaos.py, each ending in the byte-identical
@@ -790,6 +806,9 @@ def main() -> None:
         # Recovery next, while the parent is still jax-free: the
         # kill/restart scenario forks its own replica processes too.
         ("recovery", bench_recovery),
+        # Overload likewise forks its replica and keeps the parent
+        # jax-free (loadgen is numpy + asyncio only).
+        ("overload", bench_overload),
         ("config1_default", bench_config1),
         ("config2_zipf", bench_config2_zipf),
         ("config3_linked_pending", lambda: bench_exact("config3")),

@@ -1,0 +1,567 @@
+"""Front-door subsystem: LRU session eviction, admission control (BUSY
+sheds), fair request-queue accounting, checkpoint round-trips of the LRU
+order, client backoff, and the open-loop load harness smoke
+(ISSUE 9; docs/FRONT_DOOR.md).
+
+Replica-level tests drive on_request/commit directly on a single-replica
+in-process cluster with a recording bus stub — the full prepare→WAL→
+commit path runs inline (replica_count=1, serial), so session state
+transitions are the REAL ones, while every client-bound send is
+captured. The smoke test spawns a real `cli.py start` process and runs
+the loadgen harness against it end-to-end (a few hundred sessions,
+seconds-bounded — the tier-1 twin of bench.py's `overload` section)."""
+
+import asyncio
+import dataclasses
+import socket
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import tracer, types
+from tigerbeetle_tpu.constants import TEST_MIN
+from tigerbeetle_tpu.testing.cluster import Cluster
+from tigerbeetle_tpu.vsr import header as hdr
+from tigerbeetle_tpu.vsr.header import Command, Message, Operation
+
+
+class BusRec:
+    """Recording bus stub: captures every client-bound message."""
+
+    def __init__(self) -> None:
+        self.sent = []  # (client_id, Message)
+
+    def send_to_client(self, client_id, msg) -> None:
+        self.sent.append((int(client_id), msg))
+
+    def send_to_replica(self, r, msg) -> None:
+        pass
+
+    def cmds(self, client_id) -> list:
+        return [
+            int(m.header["command"]) for cid, m in self.sent
+            if cid == int(client_id)
+        ]
+
+    def clear(self) -> None:
+        self.sent = []
+
+
+def make_replica(**config_overrides):
+    """Single-replica cluster (inline serial commits) with a recording
+    bus; returns (cluster, replica, busrec)."""
+    cfg = dataclasses.replace(TEST_MIN, **config_overrides)
+    cl = Cluster(replica_count=1, client_count=0, config=cfg)
+    r = cl.replicas[0]
+    rec = BusRec()
+    r.bus = rec
+    return cl, r, rec
+
+
+def send(r, client, request, op=Operation.LOOKUP_ACCOUNTS, body=None):
+    """Inject one REQUEST straight into on_request (the bus's dispatch
+    target — MAC verification happens in on_message, not under test)."""
+    if body is None:
+        body = (
+            np.zeros(1, dtype=types.ID_DTYPE).tobytes() if op >= 128 else b""
+        )
+    h = hdr.make(
+        Command.REQUEST, r.cluster, client=client, request=request,
+        operation=op,
+    )
+    r.on_request(Message(h, body).seal())
+
+
+def register(r, client, request=1):
+    send(r, client, request, op=Operation.REGISTER)
+
+
+# --- LRU eviction ---------------------------------------------------------
+
+
+class TestLRUEviction:
+    def test_evicts_least_recently_active_not_oldest_registered(self):
+        _cl, r, rec = make_replica(clients_max=4)
+        for i, c in enumerate((101, 102, 103, 104)):
+            register(r, c)
+        # 101 registered FIRST (oldest session) but is the most recently
+        # ACTIVE after this request: the old min-session scan would have
+        # evicted it anyway; LRU must evict 102 instead.
+        send(r, 101, request=2)
+        register(r, 105)
+        assert 101 in r.clients and 105 in r.clients
+        assert 102 not in r.clients, "LRU eviction must pick the idlest"
+        assert len(r.clients) == 4
+
+    def test_lru_order_is_dict_order(self):
+        _cl, r, _rec = make_replica(clients_max=8)
+        for c in (201, 202, 203):
+            register(r, c)
+        send(r, 202, request=2)
+        send(r, 201, request=2)
+        assert list(r.clients) == [203, 202, 201]
+        lastops = [r.clients[c].last_op for c in r.clients]
+        assert lastops == sorted(lastops)
+
+    def test_eviction_at_10k_sessions_and_floor(self):
+        from tigerbeetle_tpu.vsr.replica import ClientSession
+
+        _cl, r, rec = make_replica(clients_max=10_000)
+        # Bulk-fill the table below clients_max (synthetic sessions in
+        # ascending last_op order — the invariant the commit path keeps).
+        for i in range(9_999):
+            cid = 1_000_000 + i
+            sess = ClientSession(session=i + 1)
+            r.clients[cid] = sess
+        first = next(iter(r.clients))
+        register(r, 77)  # 10_000th session: no eviction yet
+        assert len(r.clients) == 10_000 and first in r.clients
+        register(r, 78)  # one over: exactly one eviction, the LRU front
+        assert len(r.clients) == 10_000
+        assert first not in r.clients and 78 in r.clients
+
+        # Eviction floor: a just-elected primary must NOT judge unknown
+        # sessions while inherited ops are uncommitted — drop, no
+        # EVICTION reply.
+        rec.clear()
+        r._eviction_floor = r.commit_min + 5
+        send(r, 999_999, request=3)
+        assert rec.cmds(999_999) == []
+        r._eviction_floor = 0
+        send(r, 999_999, request=3)
+        assert rec.cmds(999_999) == [Command.EVICTION]
+
+
+class TestEvictionUnderChurn:
+    def test_eviction_while_request_in_pipeline(self):
+        """A session evicted by a REGISTER committing AHEAD of its queued
+        request: the request still commits (reply sent), the session is
+        gone, and the client learns via EVICTION on its next request —
+        then re-registers and works."""
+        _cl, r, rec = make_replica(clients_max=2)
+        register(r, 301)
+        register(r, 302)
+        send(r, 301, request=2)  # 302 is now the LRU victim
+        # Gate commits (the grid-repair gate): prepares stack in the
+        # pipeline in arrival order.
+        r._finish_pending = True
+        register(r, 303)          # will evict 302 when it commits
+        send(r, 302, request=2)   # 302's request rides BEHIND the register
+        assert len(r.pipeline) == 2
+        rec.clear()
+        r._finish_pending = False
+        r._check_pipeline_quorum()
+        assert 302 not in r.clients and 303 in r.clients
+        # The in-pipeline request of the evicted session still executed
+        # and its reply was sent (the client treats it as a normal
+        # reply; the session cache just no longer holds it).
+        assert Command.REPLY in rec.cmds(302)
+        rec.clear()
+        send(r, 302, request=3)
+        assert rec.cmds(302) == [Command.EVICTION]
+        # Re-register → fresh session → requests flow again.
+        register(r, 302)  # request number 1 of the NEW session
+        rec.clear()
+        send(r, 302, request=2)
+        assert rec.cmds(302) == [Command.REPLY]
+
+    def test_reregister_replay_dup_suppression(self):
+        """After eviction → re-register, a replayed OLD request number
+        must not re-execute: it returns the cached reply (or nothing),
+        and commit_min does not advance."""
+        _cl, r, rec = make_replica(clients_max=2)
+        register(r, 401)
+        send(r, 401, request=2)
+        register(r, 402)
+        register(r, 403)  # evicts 401 (LRU)
+        assert 401 not in r.clients
+        register(r, 401, request=3)  # re-register, numbering continues
+        send(r, 401, request=4)
+        committed = r.commit_min
+        rec.clear()
+        send(r, 401, request=4)  # exact resend → cached reply, no commit
+        assert rec.cmds(401) == [Command.REPLY]
+        assert r.commit_min == committed
+        rec.clear()
+        send(r, 401, request=3)  # stale replay (the register's number)
+        assert rec.cmds(401) == []
+        assert r.commit_min == committed
+
+    def test_session_state_survives_checkpoint_restart_in_lru_order(self):
+        """The LRU order is replicated state: after checkpoint + crash +
+        restart (snapshot install + WAL replay), the client table comes
+        back in the same recency order with the same last_op values."""
+        cfg = dataclasses.replace(TEST_MIN, clients_max=4)
+        cl = Cluster(replica_count=1, client_count=0, config=cfg)
+        r = cl.replicas[0]
+        r.bus = BusRec()
+        reqs = {}
+        for c in (501, 502, 503):
+            register(r, c)
+            reqs[c] = 1
+        # Drive past a checkpoint (TEST_MIN interval 16) with a known
+        # touch pattern.
+        i = 0
+        while r.superblock.state.op_checkpoint == 0 or r.commit_min < 20:
+            c = (501, 502, 503)[i % 3]
+            reqs[c] += 1
+            send(r, c, reqs[c])
+            i += 1
+        send(r, 502, reqs[502] + 1)  # 502 most recent
+        order_before = list(r.clients)
+        lastop_before = {c: s.last_op for c, s in r.clients.items()}
+        cl.crash_replica(0, torn_write_probability=0.0)
+        cl.restart_replica(0)
+        r2 = cl.replicas[0]
+        assert list(r2.clients) == order_before
+        assert {c: s.last_op for c, s in r2.clients.items()} == lastop_before
+        # And the rebuilt order drives eviction identically: 504 fills
+        # the 4th slot (no eviction), 505 evicts the rebuilt LRU front.
+        r2.bus = BusRec()
+        register(r2, 504)
+        assert order_before[0] in r2.clients
+        register(r2, 505)
+        assert order_before[0] not in r2.clients
+        assert order_before[1] in r2.clients and 502 in r2.clients
+
+
+# --- admission control ----------------------------------------------------
+
+
+class TestAdmissionControl:
+    def _gated_replica(self, **over):
+        cl, r, rec = make_replica(clients_max=32, **over)
+        for c in range(601, 613):
+            register(r, c)
+        r._finish_pending = True  # commits gate: prepares stack up
+        return cl, r, rec
+
+    def test_queue_bound_sheds_with_busy(self):
+        _cl, r, rec = self._gated_replica(request_queue_max=2)
+        pmax = r.config.pipeline_max
+        # Fill the pipeline, then the queue, then shed.
+        for i in range(pmax + 2):
+            send(r, 601 + i, request=2)
+        assert len(r.pipeline) == pmax
+        assert len(r.request_queue) == 2
+        rec.clear()
+        send(r, 601 + pmax + 2, request=2)
+        assert rec.cmds(601 + pmax + 2) == [Command.BUSY]
+        assert len(r.request_queue) == 2
+        # Drain: everything queued prepares + commits; accounting empties.
+        rec.clear()
+        r._finish_pending = False
+        r._check_pipeline_quorum()
+        assert not r.request_queue and not r._queued_req
+        for i in range(pmax + 2):
+            assert Command.REPLY in rec.cmds(601 + i)
+
+    def test_hot_session_cannot_take_two_backlog_slots(self):
+        _cl, r, rec = self._gated_replica(request_queue_max=8)
+        pmax = r.config.pipeline_max
+        for i in range(pmax):
+            send(r, 601 + i, request=2)
+        send(r, 612, request=2)  # queued (slot 1 for session 612)
+        assert r._queued_req[612] == 2
+        rec.clear()
+        send(r, 612, request=2)  # resend of the queued entry: dropped
+        assert rec.cmds(612) == []
+        send(r, 612, request=3)  # one-in-flight violation: shed
+        assert rec.cmds(612) == [Command.BUSY]
+        assert len(r.request_queue) == 1
+
+    def test_busy_reply_is_not_eviction(self):
+        _cl, r, rec = self._gated_replica(request_queue_max=1)
+        pmax = r.config.pipeline_max
+        for i in range(pmax + 1):
+            send(r, 601 + i, request=2)
+        shed_client = 601 + pmax + 1
+        rec.clear()
+        send(r, shed_client, request=2)
+        (msg,) = [m for cid, m in rec.sent if cid == shed_client]
+        h = msg.header
+        assert h["command"] == Command.BUSY
+        assert h["request"] == 2  # echoes the shed request for matching
+        assert shed_client in r.clients  # session intact — NOT evicted
+
+    def test_latency_admission_arms_and_disarms(self):
+        """config.admission_p99_ms: windowed perceived p99 above the bound
+        arms shedding at tick granularity; a quiet window disarms it."""
+        tracer.reset()
+        tracer.enable()
+        # Synthetic 50 ms ops would trip the flight recorder's latency
+        # rule and dump to disk — silence it for the test.
+        tracer.configure_flight(latency_mult=1e9, stall_ms=1e9, max_dumps=0)
+        try:
+            _cl, r, rec = make_replica(admission_p99_ms=5.0)
+            register(r, 701)
+            register(r, 702)
+
+            def feed(perceived_ms, n=64):
+                for i in range(n):
+                    rec2 = tracer.op_begin()
+                    t0 = 1_000_000_000 + i * 50_000_000
+                    tracer.op_stamp(rec2, tracer.OP_ARRIVE, t0)
+                    tracer.op_stamp(
+                        rec2, tracer.OP_REPLY,
+                        t0 + int(perceived_ms * 1e6),
+                    )
+                    tracer.op_finish(rec2)
+
+            from tigerbeetle_tpu.vsr.replica import ADMISSION_CHECK_TICKS
+
+            def tick_to_check():
+                for _ in range(ADMISSION_CHECK_TICKS):
+                    r.tick()
+
+            feed(1.0)
+            tick_to_check()  # prime the window state
+            feed(1.0)
+            tick_to_check()
+            assert r._latency_shed is False
+            feed(50.0)
+            tick_to_check()
+            assert r._latency_shed is True
+            assert r._admission_full() == "latency"
+            # A total stall (no ops finalized) must HOLD the armed
+            # state, not fail open while latency is at its worst.
+            tick_to_check()
+            assert r._latency_shed is True
+            feed(1.0)
+            tick_to_check()
+            assert r._latency_shed is False
+        finally:
+            tracer.disable()
+            tracer.reset()
+            tracer.configure_flight(
+                latency_mult=8.0, stall_ms=2000.0, max_dumps=3
+            )
+
+
+def test_tracer_windowed_perceived_p99():
+    tracer.reset()
+    tracer.enable()
+    tracer.configure_flight(latency_mult=1e9, stall_ms=1e9, max_dumps=0)
+    try:
+        def feed(ms, n):
+            for i in range(n):
+                rec = tracer.op_begin()
+                t0 = 1_000_000_000 + i * 40_000_000
+                tracer.op_stamp(rec, tracer.OP_ARRIVE, t0)
+                tracer.op_stamp(rec, tracer.OP_REPLY, t0 + int(ms * 1e6))
+                tracer.op_finish(rec)
+
+        state: dict = {}
+        feed(10.0, 100)
+        assert tracer.perceived_p99_ms(state) is None  # priming call
+        feed(50.0, 100)
+        p = tracer.perceived_p99_ms(state)
+        assert 40.0 < p < 65.0  # window covers ONLY the 50 ms ops
+        # EMPTY window = no evidence (a stall finalizes no ops): None,
+        # so the admission layer holds state instead of failing open.
+        assert tracer.perceived_p99_ms(state) is None
+        # Lifetime percentile (no window state) sees both populations.
+        assert tracer.perceived_p99_ms() > 40.0
+    finally:
+        tracer.disable()
+        tracer.reset()
+        tracer.configure_flight(latency_mult=8.0, stall_ms=2000.0, max_dumps=3)
+
+
+# --- client BUSY backoff --------------------------------------------------
+
+
+class _FakeReplica(threading.Thread):
+    """One-connection fake server: replies to REGISTER, sheds the next
+    request with BUSY exactly `busy_count` times, then replies."""
+
+    def __init__(self, busy_count=1):
+        super().__init__(daemon=True)
+        self.busy_count = busy_count
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(1)
+        self.port = self.sock.getsockname()[1]
+        self.busy_sent = 0
+
+    def run(self):
+        conn, _ = self.sock.accept()
+        buf = b""
+
+        def read_msg():
+            # Persistent buffer: the hello + register often coalesce into
+            # one recv; a per-call buffer would drop the remainder.
+            nonlocal buf
+            while True:
+                if len(buf) >= hdr.HEADER_SIZE:
+                    h = hdr.Header.from_bytes(buf[: hdr.HEADER_SIZE])
+                    size = int(h["size"])
+                    if len(buf) >= size:
+                        buf = buf[size:]  # body (if any) is irrelevant here
+                        return h
+                chunk = conn.recv(1 << 16)
+                if not chunk:
+                    return None
+                buf += chunk
+
+        with conn:
+            while True:
+                h = read_msg()
+                if h is None:
+                    return
+                cmd = int(h["command"])
+                if cmd == Command.PING_CLIENT:
+                    continue
+                if cmd != Command.REQUEST:
+                    continue
+                client, request = int(h["client"]), int(h["request"])
+                op = int(h["operation"])
+                if (
+                    op != Operation.REGISTER
+                    and self.busy_sent < self.busy_count
+                ):
+                    self.busy_sent += 1
+                    busy = hdr.make(
+                        Command.BUSY, 0, client=client, request=request,
+                    )
+                    conn.sendall(Message(busy).seal().to_bytes())
+                    continue
+                reply = hdr.make(
+                    Command.REPLY, 0, client=client, request=request,
+                    operation=op,
+                )
+                conn.sendall(Message(reply).seal().to_bytes())
+
+
+def test_sync_client_busy_backoff():
+    from tigerbeetle_tpu.client import Client
+
+    srv = _FakeReplica(busy_count=2)
+    srv.start()
+    client = Client([("127.0.0.1", srv.port)])
+    t0 = time.perf_counter()
+    client.lookup_accounts([1])
+    dt = time.perf_counter() - t0
+    assert srv.busy_sent == 2
+    assert client.busy_count == 2
+    assert dt >= 0.02  # two backoff pauses (10ms + 20ms) were honored
+    client.close()
+
+
+def test_async_client_busy_backoff():
+    from tigerbeetle_tpu.client import AsyncClient
+
+    srv = _FakeReplica(busy_count=1)
+    srv.start()
+
+    async def go():
+        ac = AsyncClient([("127.0.0.1", srv.port)], sessions=1)
+        await ac.start()
+        ids = np.zeros(1, dtype=types.ID_DTYPE)
+        await ac.submit(Operation.LOOKUP_ACCOUNTS, ids)
+        await ac.close()
+        return ac.busy_count
+
+    assert asyncio.run(go()) == 1
+
+
+# --- determinism: the new session layer through the simulator -------------
+
+
+def test_lru_session_layer_cluster_determinism():
+    """Two identically-seeded 3-replica clusters with session churn
+    (registers + requests from rotating clients at a tiny clients_max)
+    must converge to identical commit-checksum chains — the LRU
+    move-to-end and eviction order are replicated state."""
+    def drive(seed):
+        cfg = dataclasses.replace(TEST_MIN, clients_max=2)
+        cl = Cluster(replica_count=3, client_count=4, config=cfg, seed=seed)
+        cids = sorted(cl.clients)
+        for i, cid in enumerate(cids):
+            c = cl.clients[cid]
+            c.register()
+            cl.run_until(lambda c=c: c.registered, 40_000)
+        body = np.zeros(1, dtype=types.ID_DTYPE).tobytes()
+        for round_i in range(6):
+            c = cl.clients[cids[round_i % len(cids)]]
+            if not c.registered:
+                c.register()
+                cl.run_until(lambda c=c: c.in_flight is None, 40_000)
+                continue
+            c.request(Operation.LOOKUP_ACCOUNTS, body)
+            cl.run_until(lambda c=c: c.in_flight is None, 40_000)
+        cl.run_until(
+            lambda: all(
+                r.commit_min == cl.replicas[0].commit_min
+                for r in cl.replicas if r is not None
+            ),
+            40_000,
+        )
+        r0 = cl.replicas[0]
+        chain = [
+            r0.commit_checksums[op]
+            for op in sorted(r0.commit_checksums)
+        ]
+        assert cl.check_state_convergence() > 0
+        return chain, [list(r.clients) for r in cl.replicas if r is not None]
+
+    chain_a, tables_a = drive(0xF00)
+    chain_b, tables_b = drive(0xF00)
+    assert chain_a == chain_b
+    # Every replica holds the identical LRU-ordered client table.
+    assert all(t == tables_a[0] for t in tables_a)
+    assert tables_a == tables_b
+
+
+# --- the open-loop harness, end to end (tier-1 smoke) ---------------------
+
+
+def test_loadgen_smoke_real_process():
+    """Few-hundred-session open-loop run against a real `cli.py start`
+    replica: ramp-in, disconnect storm, identity rotation, slow readers,
+    then a flood at a tiny request-queue bound to force BUSY sheds — the
+    audit (durability of acked transfers + liveness) must pass after
+    both. Seconds-bounded: the tier-1 twin of bench.py's `overload`."""
+    from tigerbeetle_tpu.testing import loadgen
+
+    with tempfile.TemporaryDirectory(prefix="tbtpu-fd-smoke-") as tmp:
+        proc, port, mport, _path = loadgen.spawn_front_door(
+            tmp, config="development", backend="numpy",
+            clients_max=600, request_queue_max=16,
+        )
+        try:
+            addrs = [("127.0.0.1", port)]
+            loadgen.create_accounts(addrs, 500)
+
+            lg = loadgen.LoadGen(
+                addrs, sessions=150, accounts=500, batch=64,
+                offered_rate=4000.0, duration_s=2.0, ramp_s=1.0,
+                slow_readers=2, seed=0x51,
+                churn=((0.8, "disconnect", 0.15), (1.4, "rotate", 0.05)),
+            )
+            res = asyncio.run(lg.run())
+            assert res["sessions_failed"] == 0
+            assert res["accepted_tx"] > 0
+            assert res["reconnects"] > 0  # the disconnect storm happened
+            assert res["perceived_p50_ms"] > 0
+            aud = loadgen.audit(addrs, lg.stats.acked_sample, mport)
+            assert aud["ok"] == 1, f"audit failed: {aud}"
+
+            # Flood far past saturation at queue bound 16: admission
+            # must shed (BUSY absorbed by sessions) and the replica must
+            # stay alive and consistent.
+            flood = loadgen.LoadGen(
+                addrs, sessions=64, accounts=500, batch=64,
+                offered_rate=200_000.0, duration_s=1.5, ramp_s=0.3,
+                seed=0x52, first_id=lg.factory.next_id,
+            )
+            fres = asyncio.run(flood.run())
+            assert fres["sheds"] > 0, f"no sheds under flood: {fres}"
+            aud2 = loadgen.audit(addrs, flood.stats.acked_sample, mport)
+            assert aud2["ok"] == 1, f"post-flood audit failed: {aud2}"
+        finally:
+            proc.kill()
+            proc.wait()

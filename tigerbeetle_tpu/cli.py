@@ -65,6 +65,22 @@ def cmd_start(args) -> int:
     from tigerbeetle_tpu.vsr.replica import Replica
 
     config = config_by_name(args.config)
+    # Front-door sizing (docs/FRONT_DOOR.md): the session table and the
+    # admission policy are operator-tunable without a config preset —
+    # --clients-max=10000 turns the reference's 32-client table into the
+    # ten-thousand-session front door. Session/admission fields are pure
+    # RAM sizing, so overriding them never touches the data-file layout.
+    import dataclasses as _dc
+
+    overrides = {}
+    if args.clients_max:
+        overrides["clients_max"] = args.clients_max
+    if args.request_queue_max:
+        overrides["request_queue_max"] = args.request_queue_max
+    if args.admission_p99_ms:
+        overrides["admission_p99_ms"] = args.admission_p99_ms
+    if overrides:
+        config = _dc.replace(config, **overrides)
     zone = Zone.for_config(
         config.journal_slot_count, config.message_size_max,
         grid_block_count=config.grid_block_count,
@@ -152,6 +168,11 @@ def cmd_start(args) -> int:
         # BEFORE open() so the boot-time recovery stamps (WAL-replay
         # gauges, vsr.recovery_state — docs/CHAOS.md) land in the
         # registry a chaos harness scrapes after a restart.
+        tracer.enable()
+    if config.admission_p99_ms > 0 and not tracer.enabled():
+        # The latency-based admission bound reads the lifecycle
+        # histogram: without the tracer it would be silently inert —
+        # an operator who configured a 50 ms bound would get none.
         tracer.enable()
     replica.open()
     host, port = addresses[args.replica]
@@ -370,6 +391,12 @@ def cmd_benchmark(args) -> int:
         ]
         if mport:
             server_args.append(f"--metrics-port={mport}")
+        if args.open_loop:
+            # The open-loop harness runs one session per connection: the
+            # server's session table must hold the whole pool.
+            server_args.append(
+                f"--clients-max={max(1024, 2 * args.sessions)}"
+            )
         if args.serial_commit:
             server_args.append("--serial-commit")
         if args.serial_store:
@@ -387,15 +414,63 @@ def cmd_benchmark(args) -> int:
             client = Client([("127.0.0.1", port)])
             batch = min(args.batch, 8190)
 
-            ids = np.arange(1, args.accounts + 1, dtype=np.uint64)
-            for s in range(0, args.accounts, batch):
-                chunk = ids[s : s + batch]
-                ev = np.zeros(len(chunk), dtype=types.ACCOUNT_DTYPE)
-                ev["id_lo"] = chunk
-                ev["ledger"] = 1
-                ev["code"] = 10
-                res = client.create_accounts(ev)
-                assert len(res) == 0
+            # One seeding contract for both loops (the harness and the
+            # recovery/overload benches share it too).
+            from tigerbeetle_tpu.testing.loadgen import create_accounts
+
+            create_accounts([("127.0.0.1", port)], args.accounts)
+
+            if args.open_loop:
+                # Open-loop path (docs/FRONT_DOOR.md): the loadgen
+                # harness drives --sessions real TCP connections with
+                # Poisson arrivals at --offered-rate; both loops emit the
+                # same BENCH_JSON shape from the same entry point.
+                # --rate=0 keeps its documented meaning — a closed-loop
+                # flood — just expressed over per-connection sessions.
+                from tigerbeetle_tpu.testing.loadgen import LoadGen
+
+                rate = (
+                    float(args.offered_rate) if args.offered_rate
+                    else (float(args.rate) if args.rate else None)
+                )
+                lg = LoadGen(
+                    [("127.0.0.1", port)],
+                    sessions=max(1, args.sessions),
+                    accounts=args.accounts, batch=batch,
+                    offered_rate=rate, duration_s=args.duration,
+                    ramp_s=min(2.0, args.sessions / 200.0), seed=0xBEE,
+                )
+                ol = asyncio.run(lg.run())
+                result = {
+                    "open_loop": 1,
+                    "offered_tx_per_s": ol["offered_tx_per_s"],
+                    "load_accepted_tx_per_s": ol["accepted_tx_per_s"],
+                    "perceived_p50_ms": ol["perceived_p50_ms"],
+                    "perceived_p90_ms": ol["perceived_p90_ms"],
+                    "perceived_p99_ms": ol["perceived_p99_ms"],
+                    "sessions": ol["sessions"],
+                    "sheds": ol["sheds"],
+                    "evictions": ol["evictions"],
+                    "timeouts": ol["timeouts"],
+                    "dropped": ol["dropped"],
+                }
+                print(f"offered = {ol['offered_tx_per_s']:,.0f} tx/s "
+                      f"({ol['sessions']} open-loop sessions)")
+                print(f"load accepted = {ol['accepted_tx_per_s']:,.0f} tx/s")
+                print(f"client-perceived p50 = {ol['perceived_p50_ms']:.2f} ms")
+                print(f"client-perceived p90 = {ol['perceived_p90_ms']:.2f} ms")
+                print(f"client-perceived p99 = {ol['perceived_p99_ms']:.2f} ms")
+                print(f"sheds = {ol['sheds']}  evictions = {ol['evictions']}  "
+                      f"dropped = {ol['dropped']}")
+                if mport:
+                    try:
+                        lc = _http_get_json(mport, "/lifecycle")
+                        result.update(lc.get("flat", {}))
+                        result["lifecycle_ops"] = lc.get("ops", 0)
+                    except (OSError, ValueError) as e:
+                        print(f"lifecycle scrape failed: {e}", file=sys.stderr)
+                print("BENCH_JSON " + json.dumps(result), flush=True)
+                return 0
 
             # Pipelined load via the AsyncClient session pool (reference
             # benchmark_load.zig drives the client's 32-deep request queue):
@@ -614,6 +689,16 @@ def main(argv=None) -> int:
                    help="serve /metrics (Prometheus text) and /trace "
                         "(Perfetto JSON) on this port from the replica's "
                         "event loop; implies tracing on")
+    s.add_argument("--clients-max", type=int, default=0,
+                   help="session-table capacity override (front door: "
+                        "10000+); 0 keeps the config preset's value")
+    s.add_argument("--request-queue-max", type=int, default=0,
+                   help="admission bound on queued requests — beyond it "
+                        "the primary sheds with a retryable BUSY; 0 keeps "
+                        "the preset's value")
+    s.add_argument("--admission-p99-ms", type=float, default=0.0,
+                   help="also shed while the windowed perceived p99 "
+                        "exceeds this many ms (0 = queue-depth bound only)")
     s.set_defaults(fn=cmd_start)
 
     a = sub.add_parser("aof", help="AOF debug/merge/recover tooling")
@@ -646,6 +731,22 @@ def main(argv=None) -> int:
     # Offered arrival rate in tx/s (reference benchmark_load.zig:13-16
     # defaults 1M tx/s offered); 0 = closed-loop flood.
     b.add_argument("--rate", type=int, default=1_000_000)
+    # Open-loop harness (testing/loadgen.py, docs/FRONT_DOOR.md): real
+    # per-session TCP connections with Poisson arrivals — queueing is
+    # observable because arrivals never wait for replies. Closed-loop
+    # (default) and open-loop numbers come from this same entry point
+    # and both emit BENCH_JSON.
+    b.add_argument("--open-loop", action="store_true",
+                   help="drive the loadgen harness (one connection per "
+                        "session, Poisson arrivals) instead of the "
+                        "closed-loop AsyncClient pool")
+    b.add_argument("--offered-rate", type=int, default=0,
+                   help="open-loop offered rate in tx/s (default: --rate)")
+    b.add_argument("--sessions", type=int, default=64,
+                   help="open-loop session count (each its own TCP "
+                        "connection)")
+    b.add_argument("--duration", type=float, default=5.0,
+                   help="open-loop run length in seconds")
     b.add_argument("--config", default="production")
     b.add_argument("--backend", default="jax", choices=["jax", "numpy"])
     b.add_argument("--metrics-port", type=int, default=0,

@@ -33,6 +33,20 @@ class SessionEvicted(ClientError):
     pass
 
 
+# Admission-control backoff (docs/FRONT_DOOR.md): a BUSY reply means the
+# primary shed the request at the door — retry the SAME request number
+# against the SAME target after an exponential pause. Distinct from
+# SessionEvicted (session killed) and from the timeout path (replica
+# unreachable → rotate targets).
+BUSY_RETRY_MAX = 64
+BUSY_BACKOFF_BASE = 0.01  # seconds; doubles per consecutive BUSY
+BUSY_BACKOFF_MAX = 0.25
+
+
+def busy_backoff_s(busy_retries: int) -> float:
+    return min(BUSY_BACKOFF_BASE * (1 << min(busy_retries - 1, 5)), BUSY_BACKOFF_MAX)
+
+
 class Client:
     REQUEST_TIMEOUT = 2.0  # seconds before retrying on the next replica
 
@@ -57,6 +71,7 @@ class Client:
         self._bufs: dict[int, bytes] = {}
         self._target = 0
         self.registered = False
+        self.busy_count = 0  # BUSY sheds absorbed (admission-control telemetry)
         self.register()
 
     # --- wire -----------------------------------------------------------
@@ -141,21 +156,26 @@ class Client:
         if (body.nbytes if isinstance(body, np.ndarray) else len(body)) > 0:
             frame.append(body)
         attempts = 4 * len(self.addresses) + 4
-        for _ in range(attempts):
+        attempt = 0
+        busy_retries = 0
+        while attempt < attempts:
             self._ensure_connections()
             target = self._target % len(self.addresses)
             s = self._socks.get(target) or self._connect(target)
             if s is None:
                 self._target += 1
+                attempt += 1
                 continue
             try:
                 self._send_frame(s, frame)
             except OSError:
                 self._socks.pop(target, None)
                 self._target += 1
+                attempt += 1
                 continue
             deadline = time.monotonic() + self.REQUEST_TIMEOUT
-            while time.monotonic() < deadline:
+            got_busy = False
+            while not got_busy and time.monotonic() < deadline:
                 socks = list(self._socks.values())
                 if not socks:
                     break
@@ -179,13 +199,37 @@ class Client:
                                 self.registered = False
                                 raise SessionEvicted("session evicted by cluster")
                             if (
+                                h["command"] == Command.BUSY
+                                and h["client"] == self.id
+                                and h["request"] == self.request_number
+                            ):
+                                # Admission shed: the primary is alive but
+                                # saturated — back off and resend the SAME
+                                # request to the SAME target. Does not
+                                # consume a rotation attempt (rotating
+                                # would just re-offer the load elsewhere
+                                # and forward it straight back).
+                                got_busy = True
+                                continue
+                            if (
                                 h["command"] == Command.REPLY
                                 and h["client"] == self.id
                                 and h["request"] == self.request_number
                             ):
                                 self._target = h["replica"]
                                 return reply
+            if got_busy:
+                busy_retries += 1
+                self.busy_count += 1
+                if busy_retries > BUSY_RETRY_MAX:
+                    raise ClientError(
+                        "shed by admission control (BUSY) "
+                        f"{busy_retries} times — cluster saturated"
+                    )
+                time.sleep(busy_backoff_s(busy_retries))
+                continue
             self._target += 1
+            attempt += 1
         raise ClientError("request timed out against every replica")
 
     @staticmethod
@@ -388,6 +432,8 @@ class AsyncClient:
         # lives exactly in that queue, so report both or the comparison
         # vs the reference flatters (advisor r4).
         self.perceived: List[float] = []
+        # BUSY sheds absorbed across all sessions (admission telemetry).
+        self.busy_count = 0
 
     async def __aenter__(self) -> "AsyncClient":
         await self.start()
@@ -450,7 +496,7 @@ class AsyncClient:
                             "cluster's clients_max?)"
                         ))
                 continue
-            if cmd == Command.REPLY:
+            if cmd in (Command.REPLY, Command.BUSY):
                 sess = self._by_client.get(h["client"])
                 if (
                     sess is not None
@@ -460,8 +506,11 @@ class AsyncClient:
                     fut = sess["inflight"]
                     sess["inflight"] = None
                     if not fut.done():
+                        # BUSY rides the same future: _request inspects
+                        # the command and backs off instead of returning.
                         fut.set_result(msg)
-                    self._target = h["replica"]
+                    if cmd == Command.REPLY:
+                        self._target = h["replica"]
 
     async def _send(self, r: int, msg: Message, body) -> bool:
         w = self._writers.get(r) or await self._connect(r)
@@ -508,19 +557,37 @@ class AsyncClient:
         loop = asyncio.get_running_loop()
         deadline_rotations = 4 * len(self.addresses) + 4
         t0 = time.perf_counter()
+        rotations = 0
+        busy_retries = 0
         try:
-            for _ in range(deadline_rotations):
+            while rotations < deadline_rotations:
                 fut = loop.create_future()
                 sess["inflight"] = fut
                 if not await self._send(self._target % len(self.addresses), msg, body):
                     self._target += 1
+                    rotations += 1
                     continue
                 try:
                     reply = await asyncio.wait_for(fut, self.REQUEST_TIMEOUT)
-                    self.latencies.append(time.perf_counter() - t0)
-                    return reply
                 except asyncio.TimeoutError:
                     self._target += 1  # rotate replicas and resend
+                    rotations += 1
+                    continue
+                if reply.header["command"] == Command.BUSY:
+                    # Admission shed: back off, resend the SAME request to
+                    # the SAME target; a shed does not consume a rotation
+                    # (the primary is alive, just saturated).
+                    busy_retries += 1
+                    self.busy_count += 1
+                    if busy_retries > BUSY_RETRY_MAX:
+                        raise ClientError(
+                            "shed by admission control (BUSY) "
+                            f"{busy_retries} times — cluster saturated"
+                        )
+                    await asyncio.sleep(busy_backoff_s(busy_retries))
+                    continue
+                self.latencies.append(time.perf_counter() - t0)
+                return reply
             raise ClientError("request timed out against every replica")
         finally:
             sess["inflight"] = None

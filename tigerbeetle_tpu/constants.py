@@ -86,6 +86,20 @@ class Config:
     # lsm.tree.DEFAULT_COMPACT_QUOTA via __post_init__-free default: the
     # literal must equal it (asserted in lsm/tree.py import sites).
     compact_quota_entries: int = 1 << 15
+    # Admission control (docs/FRONT_DOOR.md): a REQUEST arriving on the
+    # primary when request_queue already holds this many waiting requests
+    # is shed with a retryable BUSY reply instead of queued — offered
+    # load beyond saturation degrades accepted throughput gracefully
+    # instead of growing queue-wait without bound. Sized for the 10k-
+    # session front door: deep enough that a synchronized burst from a
+    # large session population rides through, shallow enough that queue
+    # wait stays bounded by ~queue_depth x batch service time.
+    request_queue_max: int = 4096
+    # Optional latency-based shed (0 = disabled): when the tracer's
+    # running perceived p99 (arrive→reply, server-side) exceeds this many
+    # milliseconds, the door sheds as if the queue were full. Checked at
+    # tick granularity, never per-request.
+    admission_p99_ms: float = 0.0
 
 
 PRODUCTION = Config()

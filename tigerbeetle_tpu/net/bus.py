@@ -53,19 +53,28 @@ class _Conn:
         self.dropped = 0  # tidy: owner=loop
         self._sends = 0  # tidy: owner=loop
         # Per-connection gauge identity (a single global would flap
-        # between unrelated transports); the name is prebuilt so the hot
-        # send path does no string formatting. Retired via close_gauge()
+        # between unrelated transports). Built LAZILY at the first
+        # sampled send (see _gauge_name): connection churn at the
+        # 10k-session front door must not pay peername lookup + string
+        # formatting + registry insertion for connections that never
+        # outlive the 64-send sampling window. Retired via close_gauge()
         # when the connection unmaps — ephemeral client ports would
         # otherwise grow the gauge registry (and every scrape) forever.
-        peer = writer.get_extra_info("peername")
-        self._sendq_gauge = (
-            f"bus.send_queue_bytes.{peer[0]}:{peer[1]}"
-            if isinstance(peer, tuple) and len(peer) >= 2
-            else "bus.send_queue_bytes.unknown"
-        )
+        self._sendq_gauge: Optional[str] = None  # tidy: owner=loop
+
+    def _gauge_name(self) -> str:
+        if self._sendq_gauge is None:
+            peer = self.writer.get_extra_info("peername")
+            self._sendq_gauge = (
+                f"bus.send_queue_bytes.{peer[0]}:{peer[1]}"
+                if isinstance(peer, tuple) and len(peer) >= 2
+                else "bus.send_queue_bytes.unknown"
+            )
+        return self._sendq_gauge
 
     def close_gauge(self) -> None:
-        tracer.remove_gauge(self._sendq_gauge)
+        if self._sendq_gauge is not None:
+            tracer.remove_gauge(self._sendq_gauge)
 
     def _can_send(self, size: int, command: Optional[int] = None) -> bool:
         """Backpressure guard: drop (and count) when the peer's send
@@ -84,7 +93,7 @@ class _Conn:
         self._sends += 1
         over = transport is not None and buffered + size > limit
         if over or (self._sends & self.SENDQ_SAMPLE_MASK) == 0:
-            tracer.gauge(self._sendq_gauge, buffered)
+            tracer.gauge(self._gauge_name(), buffered)
         if over:
             self.dropped += 1
             tracer.count("bus.dropped_messages")
@@ -192,6 +201,11 @@ class ReplicaServer:
         # compaction beats trail the reply on a dedicated thread.
         # store_async=False keeps store+beat inline in _finish_commit.
         self.store_async = store_async
+        # Client connections currently parked in the receive-side stall
+        # (docs/FRONT_DOOR.md): reads paused while the request queue is
+        # saturated, so a firehose sender backs up into TCP instead of
+        # our heap.
+        self._rx_stalled = 0  # tidy: owner=loop
         replica.bus = self  # inject ourselves as the bus
 
     @property
@@ -336,6 +350,25 @@ class ReplicaServer:
                 self.peer_conns.pop(r, None)
                 conn.close_gauge()
 
+    # Receive-side stall poll cadence: one tick — the drain rate is
+    # batches-per-tick, so polling faster only burns the loop.
+    RX_STALL_SLEEP = 0.01
+
+    def _rx_saturated(self, low_water: bool = False) -> bool:
+        """Is the primary's request backlog saturated? (The stall RELEASE
+        waits for the 3/4 low-water mark so a parked connection doesn't
+        thrash on every popleft.) Matches the send-queue backpressure
+        guard (_Conn._can_send) on the receive side: a slow-processing
+        server must stop READING a firehose connection rather than grow
+        the heap — paused reads back the sender up into TCP."""
+        r = self.replica
+        if not r.is_primary:
+            return False
+        limit = r.config.request_queue_max
+        if low_water:
+            limit = (limit * 3) // 4
+        return len(r.request_queue) >= limit
+
     async def _on_accept(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
@@ -355,6 +388,7 @@ class ReplicaServer:
                 # the client, and must win over any stale/forwarded mapping.
                 client_ids.add(h["client"])
                 self.client_conns[h["client"]] = conn
+                tracer.gauge("bus.client_conns", len(self.client_conns))
                 # Answer with the current view so the client can aim its
                 # first request at the primary instead of trial-rotating
                 # (reference ping_client/pong_client, vsr/client.zig view
@@ -384,7 +418,9 @@ class ReplicaServer:
                 # and must not steal the client's reply route.
                 if peer_replica is None and h["client"] != 0:
                     client_ids.add(h["client"])
-                    self.client_conns.setdefault(h["client"], conn)
+                    if h["client"] not in self.client_conns:
+                        self.client_conns[h["client"]] = conn
+                        tracer.gauge("bus.client_conns", len(self.client_conns))
             elif h["replica"] != self.me_index:
                 r = h["replica"]
                 if cmd == Command.PING:
@@ -400,9 +436,35 @@ class ReplicaServer:
                     peer_replica = r
                     self.peer_conns.setdefault(r, conn)
             self._dispatch(msg)
+            if (
+                cmd == Command.REQUEST and h["client"] != 0
+                and peer_replica is None and self._rx_saturated()
+            ):
+                # Receive-side backpressure (the satellite of the send
+                # guard above): the dispatch just shed/queued into a FULL
+                # backlog — reading more off this connection can only
+                # produce sheds, so park the read loop until the queue
+                # drains to the low-water mark. Direct client connections
+                # only: peer traffic (prepares, view protocol, forwarded
+                # requests re-arriving here) is the recovery path for
+                # everything and must never stall.
+                tracer.count("bus.rx_stalls")
+                self._rx_stalled += 1
+                tracer.gauge("bus.rx_stalled_conns", self._rx_stalled)
+                try:
+                    while (
+                        not self._stopping.is_set()
+                        and self._rx_saturated(low_water=True)
+                    ):
+                        await asyncio.sleep(self.RX_STALL_SLEEP)
+                finally:
+                    self._rx_stalled -= 1
+                    tracer.gauge("bus.rx_stalled_conns", self._rx_stalled)
         for cid in client_ids:
             if self.client_conns.get(cid) is conn:
                 del self.client_conns[cid]
+        if client_ids:
+            tracer.gauge("bus.client_conns", len(self.client_conns))
         if peer_replica is not None and self.peer_conns.get(peer_replica) is conn:
             del self.peer_conns[peer_replica]
         conn.close_gauge()

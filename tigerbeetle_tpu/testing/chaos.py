@@ -603,12 +603,14 @@ def scrape_recovery_gauges(mport: int) -> Dict[str, float]:
 
 def _spawn_replica(
     path: str, port: int, mport: int, config: str, backend: str,
+    extra_args: Sequence[str] = (),
 ) -> "object":
     """Start `cli.py start` detached; returns the Popen once the replica
     announces its listener (after open(), i.e. after WAL replay — or at
     EOF, when the process died and the caller's connect will fail). A
     daemon thread drains stdout afterwards so a chatty replica can never
-    block on a full pipe mid-scenario."""
+    block on a full pipe mid-scenario. `extra_args` rides extra cli.py
+    start flags (the front-door loadgen passes --clients-max etc.)."""
     import subprocess
     import sys
     import threading
@@ -618,7 +620,7 @@ def _spawn_replica(
             sys.executable, "-m", "tigerbeetle_tpu.cli", "start",
             f"--addresses=127.0.0.1:{port}", "--replica=0",
             f"--config={config}", f"--backend={backend}",
-            f"--metrics-port={mport}", path,
+            f"--metrics-port={mport}", *extra_args, path,
         ],
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
     )

@@ -150,7 +150,17 @@ class SimClient:
                 if self.on_reply is not None:
                     self.on_reply(msg)
         elif h["command"] == Command.EVICTION:
+            # Eviction is a TERMINAL answer to the in-flight request: the
+            # session is gone server-side, so resending it forever would
+            # wedge the client (the cluster keeps answering EVICTION).
+            # Drop the request; the test workload re-registers.
             self.registered = False
+            self.in_flight = None
+        elif h["command"] == Command.BUSY and h["client"] == self.id:
+            # Admission shed: leave in_flight armed — the tick-based
+            # resend IS the sim client's backoff (RESEND_TICKS ≫ any
+            # realistic drain time at sim scale).
+            pass
 
     def tick(self) -> None:
         if self.in_flight is not None and (

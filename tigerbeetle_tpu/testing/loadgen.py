@@ -1,0 +1,760 @@
+"""Open-loop front-door load harness: thousands of real TCP sessions.
+
+The closed-loop benchmark (cli.py benchmark's AsyncClient pool) can never
+observe queueing: every session waits for its reply before offering the
+next request, so offered load self-throttles to accepted load. This
+harness is OPEN-LOOP (docs/FRONT_DOOR.md): arrivals fire on a Poisson
+schedule at a *configured offered rate* regardless of replies, each
+stamped at its scheduled arrival time — perceived latency (arrival →
+reply) then includes every queue the request crossed: the session's own
+backlog, TCP, the primary's request queue, and BUSY backoff. That is the
+quantity the ROADMAP's perceived_p50 bar is about, and the quantity
+admission control exists to bound.
+
+Pieces:
+
+  _Session    one VSR client session on its OWN TCP connection (the point
+              is connection scale, not socket multiplexing): register,
+              one request in flight, BUSY backoff, EVICTION →
+              re-register → resend, reconnect-with-retry on connection
+              loss. A slow-reader session delays its reads to exercise
+              the server's send-queue backpressure.
+  LoadGen     N sessions + Poisson arrival generator (Zipf account skew)
+              + churn schedule: ramp-in, abrupt disconnect storms
+              (transport.abort — no FIN), identity rotation (fresh
+              client ids → REGISTER churn → LRU evictions at the
+              clients_max fence), slow readers.
+  spawn / audit / run_overload_bench
+              real `cli.py start` process management (reusing
+              testing/chaos.py's spawn + port probing), post-run
+              durability/consistency audit, and the bench.py `overload`
+              section: saturation probe → accepted-vs-offered curves at
+              1x/2x/5x → a big-session churn run.
+
+Used by `cli.py benchmark --open-loop`, bench.py's `overload` section,
+and the tier-1 smoke in tests/test_front_door.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import secrets
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.client import BUSY_RETRY_MAX, busy_backoff_s
+from tigerbeetle_tpu.vsr import header as hdr
+from tigerbeetle_tpu.vsr.header import Command, Message, Operation
+
+Address = Tuple[str, int]
+
+
+def zipf_cdf(n_accounts: int, s: float) -> Optional[np.ndarray]:
+    """Inverse-CDF table for Zipf(s) account skew; None = uniform."""
+    if s <= 0.0:
+        return None
+    k = np.arange(1, n_accounts + 1, dtype=np.float64)
+    w = k ** -s
+    cdf = np.cumsum(w)
+    cdf /= cdf[-1]
+    return cdf
+
+
+class _BatchFactory:
+    """Transfer batches with globally unique ids and Zipf-skewed account
+    pairs. One factory per run — sessions draw from it on the loop thread
+    (no locking needed), so ids never collide across sessions."""
+
+    def __init__(
+        self, accounts: int, batch: int, zipf_s: float, seed: int,
+        first_id: int = 1,
+    ) -> None:
+        self.rng = np.random.default_rng(seed)
+        self.accounts = accounts
+        self.batch = batch
+        self.cdf = zipf_cdf(accounts, zipf_s)
+        self.next_id = first_id
+
+    def _draw(self, n: int) -> np.ndarray:
+        if self.cdf is None:
+            return self.rng.integers(1, self.accounts + 1, n).astype(np.uint64)
+        u = self.rng.random(n)
+        return (np.searchsorted(self.cdf, u) + 1).clip(
+            1, self.accounts
+        ).astype(np.uint64)
+
+    def make(self) -> Tuple[int, int, bytes]:
+        """(first_id, n_events, body bytes) for one transfer batch."""
+        n = self.batch
+        first = self.next_id
+        self.next_id += n
+        ev = np.zeros(n, dtype=types.TRANSFER_DTYPE)
+        ev["id_lo"] = np.arange(first, first + n, dtype=np.uint64)
+        dr = self._draw(n)
+        cr = self._draw(n)
+        cr = np.where(cr == dr, (cr % self.accounts) + 1, cr)
+        ev["debit_account_id_lo"] = dr
+        ev["credit_account_id_lo"] = cr
+        ev["amount_lo"] = self.rng.integers(1, 1000, n)
+        ev["ledger"] = 1
+        ev["code"] = 7
+        return first, n, ev.tobytes()
+
+
+class _Evicted(Exception):
+    pass
+
+
+class _Rotated(Exception):
+    """The churn task swapped this session's identity while a roundtrip
+    was in flight: the pre-sealed frame carries the abandoned client id
+    and can never be answered — abandon it and retry under the new id."""
+
+
+@dataclass
+class _Stats:
+    """Shared run counters (single asyncio loop — no locking)."""
+
+    offered_tx: int = 0
+    accepted_tx: int = 0
+    sheds: int = 0  # BUSY replies absorbed (incl. retries)
+    evictions: int = 0
+    reregisters: int = 0
+    reconnects: int = 0
+    timeouts: int = 0
+    dropped: int = 0  # arrivals abandoned (retry budget exhausted)
+    perceived: List[float] = field(default_factory=list)
+    # Sample of acked transfer ids for the post-run durability audit.
+    acked_sample: List[int] = field(default_factory=list)
+
+    def record_acked(self, first_id: int, n: int) -> None:
+        if len(self.acked_sample) < 256:
+            self.acked_sample.append(first_id)
+            self.acked_sample.append(first_id + n - 1)
+
+
+class _Session:
+    """One VSR client session on its own TCP connection."""
+
+    REQUEST_TIMEOUT = 5.0
+    CONNECT_RETRIES = 40
+
+    def __init__(
+        self, lg: "LoadGen", addresses: Sequence[Address], cluster: int = 0,
+    ) -> None:
+        self.lg = lg
+        self.addresses = list(addresses)
+        self.cluster = cluster
+        self.client_id = secrets.randbits(127) | 1
+        self.request = 0
+        self.reader: Optional[asyncio.StreamReader] = None
+        self.writer: Optional[asyncio.StreamWriter] = None
+        self.queue: "asyncio.Queue" = asyncio.Queue()
+        self.slow_s = 0.0  # per-read delay: the slow-reader client model
+        self.registered = False
+        self.alive = True
+
+    # --- wire ----------------------------------------------------------
+
+    async def _connect(self) -> None:
+        backoff = 0.05
+        last: Optional[Exception] = None
+        for _ in range(self.CONNECT_RETRIES):
+            try:
+                host, port = self.addresses[0]
+                self.reader, self.writer = await asyncio.open_connection(
+                    host, port, limit=1 << 21
+                )
+                hello = hdr.make(
+                    Command.PING_CLIENT, self.cluster, client=self.client_id
+                )
+                self.writer.write(Message(hello).seal().to_bytes())
+                await self.writer.drain()
+                return
+            except OSError as e:
+                last = e
+                self.reader = self.writer = None
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 1.0)
+        raise ConnectionError(f"session could not connect: {last!r}")
+
+    def kill_connection(self) -> None:
+        """Abrupt close (no FIN handshake) — the disconnect-storm model."""
+        if self.writer is not None:
+            transport = self.writer.transport
+            if transport is not None:
+                transport.abort()
+        self.reader = self.writer = None
+
+    def rotate_identity(self) -> None:
+        """Abandon this client id and become a brand-new session: drives
+        REGISTER churn and, once the table is at clients_max, LRU
+        evictions of the idlest sessions."""
+        self.kill_connection()
+        self.client_id = secrets.randbits(127) | 1
+        self.request = 0
+        self.registered = False
+
+    async def _read_reply(self, request: int) -> Message:
+        """Read until this request's REPLY / BUSY / EVICTION (skipping
+        pongs and stale replies). A slow reader sleeps before each read —
+        replies pile into the server's send buffer, exercising the
+        send-queue guard."""
+        from tigerbeetle_tpu.net.bus import read_message
+
+        while True:
+            if self.slow_s:
+                await asyncio.sleep(self.slow_s)
+            msg = await read_message(self.reader)
+            if msg is None:
+                raise ConnectionResetError("connection lost")
+            h = msg.header
+            cmd = h["command"]
+            if cmd == Command.EVICTION:
+                if h["client"] == self.client_id:
+                    raise _Evicted()
+                continue  # stale eviction for a rotated-away identity
+            if h["client"] != self.client_id or h["request"] != request:
+                continue
+            if cmd in (Command.REPLY, Command.BUSY):
+                return msg
+
+    async def roundtrip(self, operation: int, body: bytes) -> Message:
+        """One request through the session contract: send, absorb BUSY
+        with backoff, resend on timeout/disconnect, raise _Evicted on
+        eviction. Consumes ONE request number (resends reuse it — the
+        primary's dup suppression makes that safe)."""
+        self.request += 1
+        request = self.request
+        req = hdr.make(
+            Command.REQUEST, self.cluster, client=self.client_id,
+            request=request, operation=operation,
+        )
+        frame = Message(req, body).seal().to_bytes()
+        cid = self.client_id
+        busy_retries = 0
+        sends = 0
+        while True:
+            if self.client_id != cid:
+                raise _Rotated()  # frame is sealed under the OLD identity
+            if self.writer is None:
+                await self._connect()
+                self.lg.stats.reconnects += 1
+            try:
+                self.writer.write(frame)
+                await self.writer.drain()
+                sends += 1
+                reply = await asyncio.wait_for(
+                    self._read_reply(request), self.REQUEST_TIMEOUT
+                )
+            except asyncio.TimeoutError:
+                self.lg.stats.timeouts += 1
+                if sends > 8:
+                    raise
+                continue
+            except (OSError, ConnectionResetError):
+                self.kill_connection()
+                continue
+            if reply.header["command"] == Command.BUSY:
+                busy_retries += 1
+                self.lg.stats.sheds += 1
+                if busy_retries > BUSY_RETRY_MAX:
+                    raise TimeoutError("persistently BUSY")
+                await asyncio.sleep(busy_backoff_s(busy_retries))
+                continue
+            return reply
+
+    async def register(self) -> None:
+        if self.registered:
+            return
+        await self.roundtrip(Operation.REGISTER, b"")
+        self.registered = True
+
+    # --- arrival consumption -------------------------------------------
+
+    async def run(self) -> None:
+        """Drain this session's arrival backlog. Each arrival keeps its
+        SCHEDULED time: perceived latency includes backlog wait, BUSY
+        backoff, eviction re-registration, and reconnects."""
+        stats = self.lg.stats
+        while True:
+            item = await self.queue.get()
+            if item is None:
+                return
+            t_arr, first_id, n, body = item
+            try:
+                for _ in range(3):  # eviction/rotation → re-register → resend
+                    try:
+                        await self.register()
+                        await self.roundtrip(Operation.CREATE_TRANSFERS, body)
+                        break
+                    except _Evicted:
+                        stats.evictions += 1
+                        self.registered = False
+                        self.request = 0
+                        stats.reregisters += 1
+                    except _Rotated:
+                        stats.reregisters += 1  # new identity registers
+                else:
+                    stats.dropped += 1
+                    continue
+            except (
+                OSError, ConnectionError, asyncio.TimeoutError, TimeoutError,
+            ):
+                stats.dropped += 1
+                if not self.lg.running:
+                    return
+                continue
+            stats.accepted_tx += n
+            stats.perceived.append(time.perf_counter() - t_arr)
+            stats.record_acked(first_id, n)
+
+    async def run_closed_loop(self) -> None:
+        """Closed-loop driver (saturation probe): offer the next batch
+        the moment the previous reply lands."""
+        stats = self.lg.stats
+        while self.lg.running:
+            first_id, n, body = self.lg.factory.make()
+            stats.offered_tx += n
+            t0 = time.perf_counter()
+            try:
+                await self.register()
+                await self.roundtrip(Operation.CREATE_TRANSFERS, body)
+            except _Evicted:
+                stats.evictions += 1
+                self.registered = False
+                self.request = 0
+                continue
+            except _Rotated:
+                continue
+            except (OSError, ConnectionError, asyncio.TimeoutError, TimeoutError):
+                stats.dropped += 1
+                continue
+            stats.accepted_tx += n
+            stats.perceived.append(time.perf_counter() - t0)
+            stats.record_acked(first_id, n)
+
+
+class LoadGen:
+    """N sessions, a Poisson arrival generator, and a churn schedule.
+
+    churn: sequence of (at_s, kind, fraction) fired once each —
+      "disconnect"  abort fraction of connections (sessions reconnect and
+                    resume their ids: connection churn ≠ session churn)
+      "rotate"      fraction of sessions abandon their client id and
+                    register fresh (session churn: REGISTER storm + LRU
+                    evictions once the table is full)
+    """
+
+    def __init__(
+        self,
+        addresses: Sequence[Address],
+        *,
+        sessions: int,
+        accounts: int,
+        batch: int = 512,
+        offered_rate: Optional[float] = None,  # tx/s; None = closed loop
+        duration_s: float = 5.0,
+        ramp_s: float = 0.0,
+        zipf_s: float = 1.1,
+        seed: int = 0xF00D,
+        slow_readers: int = 0,
+        slow_s: float = 0.05,
+        churn: Sequence[Tuple[float, str, float]] = (),
+        first_id: int = 1,
+        cluster: int = 0,
+    ) -> None:
+        self.addresses = list(addresses)
+        self.n_sessions = sessions
+        self.offered_rate = offered_rate
+        self.duration_s = duration_s
+        self.ramp_s = ramp_s
+        self.churn = list(churn)
+        self.factory = _BatchFactory(accounts, batch, zipf_s, seed, first_id)
+        self.rng = np.random.default_rng(seed ^ 0x5E55)
+        self.stats = _Stats()
+        self.running = False
+        self.sessions_failed = 0
+        self.sessions = [
+            _Session(self, self.addresses, cluster) for _ in range(sessions)
+        ]
+        for sess in self.sessions[:slow_readers]:
+            sess.slow_s = slow_s
+
+    # --- arrival generation --------------------------------------------
+
+    async def _generate_open_loop(self, t_end: float) -> None:
+        """Poisson arrivals at offered_rate tx/s, round-robin across
+        sessions, stamped at their SCHEDULED time (generator lag counts
+        as queueing — that is the open loop's whole point)."""
+        rate_arrivals = self.offered_rate / self.factory.batch
+        next_t = time.perf_counter()
+        i = 0
+        n_sess = len(self.sessions)
+        while True:
+            next_t += float(self.rng.exponential(1.0 / rate_arrivals))
+            if next_t >= t_end:
+                return
+            delay = next_t - time.perf_counter()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            first_id, n, body = self.factory.make()
+            self.stats.offered_tx += n
+            self.sessions[i % n_sess].queue.put_nowait(
+                (next_t, first_id, n, body)
+            )
+            i += 1
+
+    async def _fire_churn(self, t0: float) -> None:
+        for at_s, kind, frac in sorted(self.churn):
+            delay = t0 + at_s - time.perf_counter()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            hit = self.rng.choice(
+                len(self.sessions),
+                size=max(1, int(frac * len(self.sessions))),
+                replace=False,
+            )
+            for ix in hit:
+                if kind == "disconnect":
+                    self.sessions[ix].kill_connection()
+                elif kind == "rotate":
+                    self.sessions[ix].rotate_identity()
+
+    # --- lifecycle ------------------------------------------------------
+
+    async def _ramp_in(self) -> None:
+        """Connect + register every session, staggered across ramp_s (a
+        connect storm when ramp_s=0). Registration IS load (one op per
+        session through full consensus), so it runs concurrently. Up to
+        1% stragglers are tolerated (marked dead, excluded from arrival
+        routing, reported as sessions_failed) — at thousands of sessions
+        on a loaded host one lost handshake must not void the run."""
+        n = len(self.sessions)
+
+        async def one(i: int, sess: _Session) -> None:
+            if self.ramp_s:
+                await asyncio.sleep(i * self.ramp_s / n)
+            await sess._connect()
+            await sess.register()
+
+        results = await asyncio.gather(
+            *[one(i, s) for i, s in enumerate(self.sessions)],
+            return_exceptions=True,
+        )
+        failed = [r for r in results if isinstance(r, BaseException)]
+        if failed:
+            for sess, r in zip(self.sessions, results):
+                if isinstance(r, BaseException):
+                    sess.alive = False
+                    sess.kill_connection()
+            self.sessions_failed = len(failed)
+            self.sessions = [s for s in self.sessions if s.alive]
+            if not self.sessions or len(failed) > max(1, n // 100):
+                raise ConnectionError(
+                    f"{len(failed)}/{n} sessions failed to register "
+                    f"(first: {failed[0]!r})"
+                )
+
+    async def run(self) -> dict:
+        t_setup = time.perf_counter()
+        await self._ramp_in()
+        setup_s = time.perf_counter() - t_setup
+        self.running = True
+        t0 = time.perf_counter()
+        t_end = t0 + self.duration_s
+        churn_task = (
+            asyncio.ensure_future(self._fire_churn(t0)) if self.churn else None
+        )
+        if self.offered_rate is not None:
+            runners = [
+                asyncio.ensure_future(s.run()) for s in self.sessions
+            ]
+            await self._generate_open_loop(t_end)
+            # Throughput is judged over the OFFERED window only: the
+            # drain grace below must not dilute an overloaded point's
+            # accepted rate (its backlog completing late is latency,
+            # already captured in perceived).
+            window_s = max(time.perf_counter() - t0, self.duration_s)
+            accepted_in_window = self.stats.accepted_tx
+            # Grace drain: let queued arrivals complete (bounded — an
+            # overloaded run must not wait out its whole backlog).
+            grace = t_end + max(2.0, self.duration_s)
+            while (
+                any(not s.queue.empty() for s in self.sessions)
+                and time.perf_counter() < grace
+            ):
+                await asyncio.sleep(0.05)
+            self.running = False
+            for s in self.sessions:
+                s.queue.put_nowait(None)
+            await asyncio.wait(runners, timeout=10.0)
+            for r in runners:
+                r.cancel()
+        else:
+            runners = [
+                asyncio.ensure_future(s.run_closed_loop())
+                for s in self.sessions
+            ]
+            await asyncio.sleep(self.duration_s)
+            self.running = False
+            window_s = time.perf_counter() - t0
+            accepted_in_window = self.stats.accepted_tx
+            await asyncio.wait(runners, timeout=10.0)
+            for r in runners:
+                r.cancel()
+        elapsed = time.perf_counter() - t0
+        if churn_task is not None:
+            churn_task.cancel()
+        for s in self.sessions:
+            if s.writer is not None:
+                try:
+                    s.writer.close()
+                except OSError:
+                    pass
+        return self._result(elapsed, setup_s, window_s, accepted_in_window)
+
+    def _result(
+        self, elapsed: float, setup_s: float, window_s: float,
+        accepted_in_window: int,
+    ) -> dict:
+        st = self.stats
+        p = sorted(st.perceived)
+
+        def pct(q: float) -> float:
+            if not p:
+                return 0.0
+            return p[min(len(p) - 1, int(len(p) * q))] * 1e3
+
+        return {
+            "sessions": self.n_sessions,
+            "sessions_failed": self.sessions_failed,
+            "batch": self.factory.batch,
+            "duration_s": round(elapsed, 2),
+            "window_s": round(window_s, 2),
+            "setup_s": round(setup_s, 2),
+            "offered_tx_per_s": round(st.offered_tx / max(window_s, 1e-9), 1),
+            "accepted_tx_per_s": round(
+                accepted_in_window / max(window_s, 1e-9), 1
+            ),
+            "offered_tx": st.offered_tx,
+            "accepted_tx": st.accepted_tx,
+            "perceived_p50_ms": round(pct(0.50), 3),
+            "perceived_p90_ms": round(pct(0.90), 3),
+            "perceived_p99_ms": round(pct(0.99), 3),
+            "sheds": st.sheds,
+            "evictions": st.evictions,
+            "reregisters": st.reregisters,
+            "reconnects": st.reconnects,
+            "timeouts": st.timeouts,
+            "dropped": st.dropped,
+        }
+
+
+# --- real-process orchestration -------------------------------------------
+
+
+def spawn_front_door(
+    tmpdir: str,
+    *,
+    config: str = "production",
+    backend: str = "numpy",
+    clients_max: int = 12_000,
+    request_queue_max: Optional[int] = None,
+    admission_p99_ms: Optional[float] = None,
+) -> Tuple[object, int, int, str]:
+    """Format + start a single-replica `cli.py start` process sized for
+    the front door. Returns (proc, port, metrics_port, data_path)."""
+    import argparse
+
+    from tigerbeetle_tpu.cli import cmd_format
+    from tigerbeetle_tpu.testing.chaos import _spawn_replica, probe_free_port
+
+    path = os.path.join(tmpdir, "front_door.tigerbeetle")
+    rc = cmd_format(argparse.Namespace(
+        path=path, cluster=0, replica=0, replica_count=1, config=config,
+    ))
+    assert rc == 0
+    port = probe_free_port(3200 + os.getpid() % 800)
+    mport = probe_free_port(port + 1)
+    extra = [f"--clients-max={clients_max}"]
+    if request_queue_max is not None:
+        extra.append(f"--request-queue-max={request_queue_max}")
+    if admission_p99_ms is not None:
+        extra.append(f"--admission-p99-ms={admission_p99_ms}")
+    proc = _spawn_replica(path, port, mport, config, backend, extra_args=extra)
+    return proc, port, mport, path
+
+
+def create_accounts(addresses: Sequence[Address], accounts: int) -> None:
+    from tigerbeetle_tpu.client import Client
+
+    client = Client(addresses)
+    batch = 8190
+    ids = np.arange(1, accounts + 1, dtype=np.uint64)
+    for s in range(0, accounts, batch):
+        chunk = ids[s : s + batch]
+        ev = np.zeros(len(chunk), dtype=types.ACCOUNT_DTYPE)
+        ev["id_lo"] = chunk
+        ev["ledger"] = 1
+        ev["code"] = 10
+        res = client.create_accounts(ev)
+        assert len(res) == 0
+    client.close()
+
+
+def audit(
+    addresses: Sequence[Address], acked_sample: Sequence[int], mport: int,
+) -> dict:
+    """Post-run consistency check: every sampled acked transfer must be
+    durable and readable, the replica must still be serving, and the
+    flight recorder must not have dumped an exception. The run passes
+    only with ok=1."""
+    from tigerbeetle_tpu.cli import _http_get_json
+    from tigerbeetle_tpu.client import Client
+
+    sample = list(dict.fromkeys(int(i) for i in acked_sample))[:128]
+    found = 0
+    alive = 1
+    exception_dumps = -1
+    try:
+        client = Client(addresses)
+        for s in range(0, len(sample), 64):
+            chunk = sample[s : s + 64]
+            found += len(client.lookup_transfers(chunk))
+        client.close()
+    except Exception:  # noqa: BLE001 — the audit reports, never raises
+        alive = 0
+    try:
+        lc = _http_get_json(mport, "/lifecycle")
+        exception_dumps = int(lc.get("flight", {}).get("dumps", 0))
+    except (OSError, ValueError):
+        pass
+    ok = int(alive == 1 and found == len(sample))
+    return {
+        "ok": ok,
+        "alive": alive,
+        "acked_checked": len(sample),
+        "acked_found": found,
+        "flight_dumps": exception_dumps,
+    }
+
+
+def run_overload_bench(
+    *,
+    sessions: int = int(os.environ.get("BENCH_OVERLOAD_SESSIONS", 192)),
+    churn_sessions: int = int(
+        os.environ.get("BENCH_OVERLOAD_CHURN_SESSIONS", 2000)
+    ),
+    accounts: int = 10_000,
+    batch: int = 512,
+    probe_s: float = 3.0,
+    point_s: float = 5.0,
+    churn_s: float = 8.0,
+    config: str = "production",
+    backend: str = "numpy",
+) -> dict:
+    """The bench.py `overload` section (docs/FRONT_DOOR.md):
+
+    1. saturation probe — closed-loop flood over a small session pool
+       gives the accepted ceiling (the '1x' anchor);
+    2. open-loop points at 1x/2x/5x the ceiling — accepted-vs-offered
+       and perceived p50/p99 per point (graceful shed means accepted
+       holds near the ceiling while offered climbs);
+    3. a churn run at scale — `churn_sessions` concurrent sessions
+       through ramp-in, a disconnect storm, identity rotation, and slow
+       readers, audited for durability/liveness at the end.
+
+    Gated by tools/bench_gate.py: accepted_tx_per_s_at_1x (higher
+    better), perceived_p99_ms_at_1x (lower better)."""
+    import shutil
+    import tempfile
+
+    out: dict = {}
+    tmp = tempfile.mkdtemp(prefix="tbtpu-overload-")
+    proc = None
+    t_section = time.perf_counter()
+    try:
+        # Queue bound sized BELOW the session count: with one request in
+        # flight per session, the server's queue depth can never exceed
+        # the session population — a bound above it would make the
+        # 2x/5x points accumulate client-side backlog without ever
+        # exercising the shed path this section exists to measure.
+        proc, port, mport, _path = spawn_front_door(
+            tmp, config=config, backend=backend,
+            clients_max=max(12_000, churn_sessions + sessions),
+            request_queue_max=max(32, sessions // 2),
+        )
+        addresses = [("127.0.0.1", port)]
+        create_accounts(addresses, accounts)
+
+        # 1. Saturation probe: closed loop with the SAME session shape
+        # as the open-loop points — the harness shares this host's
+        # cores with the server, so a slimmer probe would measure a
+        # ceiling the instrumented run can never reach and anchor '1x'
+        # in overload.
+        probe = LoadGen(
+            addresses, sessions=sessions, accounts=accounts, batch=batch,
+            offered_rate=None, duration_s=probe_s, ramp_s=1.0, seed=0xA11,
+        )
+        probe_res = asyncio.run(probe.run())
+        sat = max(probe_res["accepted_tx_per_s"], 1.0)
+        out["saturation_probe"] = probe_res
+        next_id = probe.factory.next_id
+
+        # 2. Open-loop points at 1x/2x/5x saturation.
+        for mult in (1, 2, 5):
+            lg = LoadGen(
+                addresses, sessions=sessions, accounts=accounts,
+                batch=batch, offered_rate=mult * sat,
+                duration_s=point_s, ramp_s=1.0, seed=0xB22 + mult,
+                first_id=next_id,
+            )
+            res = asyncio.run(lg.run())
+            out[f"at_{mult}x"] = res
+            next_id = lg.factory.next_id
+        out["accepted_tx_per_s_at_1x"] = out["at_1x"]["accepted_tx_per_s"]
+        out["perceived_p99_ms_at_1x"] = out["at_1x"]["perceived_p99_ms"]
+        at1 = max(out["at_1x"]["accepted_tx_per_s"], 1.0)
+        out["accepted_5x_over_1x_pct"] = round(
+            100.0 * out["at_5x"]["accepted_tx_per_s"] / at1, 1
+        )
+
+        # 3. Churn at session scale: offered rate well under saturation
+        # (the question is session-count + churn survival, not
+        # throughput), ramped registration, then a disconnect storm, an
+        # identity-rotation wave, and slow readers throughout.
+        churn = LoadGen(
+            addresses, sessions=churn_sessions, accounts=accounts,
+            batch=64, offered_rate=0.15 * sat, duration_s=churn_s,
+            ramp_s=max(4.0, churn_sessions / 400.0), seed=0xC33,
+            slow_readers=max(2, churn_sessions // 200),
+            churn=(
+                (churn_s * 0.3, "disconnect", 0.10),
+                (churn_s * 0.6, "rotate", 0.05),
+            ),
+            first_id=next_id,
+        )
+        churn_res = asyncio.run(churn.run())
+        churn_res["audit"] = audit(
+            addresses, churn.stats.acked_sample, mport
+        )
+        out["churn"] = churn_res
+        out["churn_sessions"] = churn_sessions
+        out["churn_audit_ok"] = churn_res["audit"]["ok"]
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except Exception:  # noqa: BLE001
+                proc.kill()
+                proc.wait()
+        shutil.rmtree(tmp, ignore_errors=True)
+    out["overload_wall_s"] = round(time.perf_counter() - t_section, 1)
+    return out

@@ -730,6 +730,31 @@ def _op_window_ns() -> int:
         return max(0, _op_window[1] - _op_window[0])
 
 
+def perceived_p99_ms(state: Optional[dict] = None) -> Optional[float]:
+    """Server-perceived (arrive→reply) p99 in milliseconds from the
+    running lifecycle histogram (the same source as the flight recorder's
+    anomaly rule). With `state` — a caller-held dict, mutated in place —
+    the percentile covers only ops finalized SINCE the previous call with
+    that dict: the admission layer's polling window, which must recover
+    once an overload passes (a lifetime percentile would stay tripped
+    forever after one burst). An EMPTY window (priming call, or zero ops
+    finalized — e.g. a total commit stall, when latency is at its worst)
+    returns None: "no evidence", so the caller HOLDS its previous armed
+    state instead of failing open."""
+    with _registry_lock:
+        cur = list(_op_hist)
+        total = _op_window[2]
+    if state is None:
+        return _hist_percentile(cur, total, 0.99) / 1e6 if total else 0.0
+    prev, prev_total = state.get("hist"), state.get("total", 0)
+    state["hist"] = cur
+    state["total"] = total
+    if prev is None or total <= prev_total:
+        return None
+    delta = [c - p for c, p in zip(cur, prev)]
+    return _hist_percentile(delta, total - prev_total, 0.99) / 1e6
+
+
 def _stage_occupancy(total_ms_of, window_ns: int) -> Dict[str, float]:
     """Little's-law stage occupancy from per-event total milliseconds
     (shared by lifecycle_summary and the /metrics gauges — the scrape

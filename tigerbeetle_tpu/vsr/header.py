@@ -53,6 +53,12 @@ class Command:
     # references that the local grid is missing.
     REQUEST_BLOCKS = 21
     BLOCK = 22
+    # Admission-control shed (docs/FRONT_DOOR.md): the primary's request
+    # queue (or perceived-latency bound) is saturated — the client should
+    # back off and RETRY the same request. Distinct from EVICTION: the
+    # session stays registered and its request number is not consumed.
+    # (Our addition — the reference sheds only by eviction.)
+    BUSY = 23
     NAMES = {}
 
 

@@ -24,7 +24,8 @@ an identical execution, byte-for-byte (SURVEY.md §4 keystone).
 from __future__ import annotations
 
 import logging
-from typing import Callable, Dict, List, Optional
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional
 
 import numpy as np
 
@@ -72,6 +73,10 @@ COMMIT_HEARTBEAT_TIMEOUT = 40
 NORMAL_HEARTBEAT_TIMEOUT = 200
 VIEW_CHANGE_TIMEOUT = 300
 REPAIR_TIMEOUT = 20
+# Latency-based admission (config.admission_p99_ms) refresh cadence: the
+# windowed perceived-p99 read takes the tracer registry lock, so it runs
+# every N ticks (~100 ms on the production 10 ms tick), never per request.
+ADMISSION_CHECK_TICKS = 10
 
 
 def _parse_headers(body: bytes) -> List[Header]:
@@ -100,12 +105,18 @@ def _event_dtype(operation: int) -> np.dtype:
 
 
 class ClientSession:
-    __slots__ = ("session", "request", "reply")
+    __slots__ = ("session", "request", "reply", "last_op")
 
     def __init__(self, session: int) -> None:
         self.session = session
         self.request = 0
         self.reply: Optional[Message] = None
+        # Op number of the session's last committed request — replicated
+        # state (applied identically at commit on every replica), so the
+        # LRU eviction order derived from it is deterministic and
+        # survives checkpoint round-trips (vsr/snapshot.py rebuilds the
+        # client-table dict sorted by last_op).
+        self.last_op = session
 
 
 class Pipeline:
@@ -215,7 +226,26 @@ class Replica:
         self.commit_min = 0  # highest committed AND executed
         self.commit_max = 0  # highest committable known
         self.pipeline: List[Pipeline] = []
-        self.request_queue: List[Message] = []
+        # FIFO backlog of admitted requests waiting for a pipeline slot.
+        # A deque: at 10k sessions the old list.pop(0) drain was O(n) per
+        # prepared request — quadratic exactly when the queue is deepest.
+        self.request_queue: Deque[Message] = deque()
+        # client → request number of that client's queued entry. One
+        # queued request per session (fair drain: a session that floods
+        # past the one-in-flight contract is shed with BUSY, it cannot
+        # occupy more than one backlog slot) and O(1) resend suppression
+        # (the old per-arrival linear scan of request_queue was O(n) at
+        # exactly the depth admission control now allows).
+        self._queued_req: Dict[int, int] = {}
+        # Latency-derived admission state (config.admission_p99_ms):
+        # updated at tick granularity from the tracer's running perceived
+        # histogram, consulted per arrival — never computed per request.
+        self._latency_shed = False
+        self._adm_p99_state: dict = {}
+        # Insertion order of `clients` IS the LRU order: every committed
+        # request for a session pops + reinserts it (O(1) move-to-end),
+        # so eviction takes the first key — no O(n) min-scan. Applied at
+        # commit in op order on every replica → deterministic.
         self.clients: Dict[int, ClientSession] = {}
 
         self.start_view_change_from: Dict[int, set[int]] = {}  # view -> replicas
@@ -567,6 +597,28 @@ class Replica:
                 if self.tick_count - self.last_commit_sent_tick >= COMMIT_HEARTBEAT_TIMEOUT:
                     self._send_commit_heartbeat()
                 self._retry_pipeline()
+                if (
+                    self.config.admission_p99_ms > 0
+                    and self.tick_count % ADMISSION_CHECK_TICKS == 0
+                    and tracer.enabled()
+                ):
+                    # Windowed perceived p99 (ops since the last check):
+                    # recovers when the overload passes, so shedding
+                    # disarms — a lifetime-running p99 would stay tripped
+                    # forever after one burst. None = EMPTY window (a
+                    # total stall finalizes no ops exactly when latency
+                    # is worst): hold the current state, never fail open.
+                    p99 = tracer.perceived_p99_ms(self._adm_p99_state)
+                    if p99 is None:
+                        shed = self._latency_shed
+                    else:
+                        shed = p99 > self.config.admission_p99_ms
+                    if shed != self._latency_shed:
+                        self._latency_shed = shed
+                        tracer.count(
+                            "vsr.admission.latency_arm" if shed
+                            else "vsr.admission.latency_disarm"
+                        )
             else:
                 if self.tick_count - self.last_heartbeat_tick >= NORMAL_HEARTBEAT_TIMEOUT:
                     self._vote_view_change(self.view + 1)
@@ -780,10 +832,10 @@ class Replica:
             if sess is None:
                 # Session is created when the register op COMMITS (it is
                 # replicated state — reference client_sessions.zig); guard
-                # against duplicate registers already in the pipeline OR
-                # in the commit stage (committed, session not yet applied
-                # — a resend there would register the client twice).
-                if not any(
+                # against duplicate registers already queued, in the
+                # pipeline, OR in the commit stage (committed, session not
+                # yet applied — a resend there would register twice).
+                if client not in self._queued_req and not any(
                     e.message.header["client"] == client
                     and e.message.header["operation"] == Operation.REGISTER
                     for e in self.pipeline
@@ -819,13 +871,20 @@ class Replica:
             return
         # Drop resends of requests still in flight (uncommitted in the
         # pipeline or queued) — preparing them twice would execute twice.
+        # The queued check is the O(1) map, not a queue scan.
+        queued_req = self._queued_req.get(client)
+        if queued_req is not None:
+            if queued_req >= h["request"]:
+                return  # resend of the queued entry
+            # A NEWER request while one still waits: the client broke the
+            # one-in-flight session contract (or a BUSY retry raced a
+            # late admit). Fair drain: one backlog slot per session — a
+            # hot session is shed, it cannot starve the rest.
+            self._shed_request(h, "session_slot")
+            return
         for pending in self.pipeline:
             ph = pending.message.header
             if ph["client"] == client and ph["request"] >= h["request"]:
-                return
-        for queued in self.request_queue:
-            qh = queued.header
-            if qh["client"] == client and qh["request"] >= h["request"]:
                 return
         # Same for ops in the commit stage: committed but not yet applied
         # (sess.request still lags), so a resend here would prepare —
@@ -870,9 +929,39 @@ class Replica:
         if sess.reply is not None:
             self.bus.send_to_client(client, sess.reply)
 
-    def _evict_oldest_client(self) -> None:
-        oldest = min(self.clients, key=lambda c: self.clients[c].session)
-        del self.clients[oldest]
+    def _evict_lru_client(self) -> None:
+        """Evict the least-recently-active session in O(1): dict insertion
+        order is maintained as recency order by _execute_tail's
+        move-to-end, so the first key is the LRU session (the old
+        min-over-session scan was O(n) per register at the 10k-session
+        front door, and evicted by REGISTRATION age — punishing the
+        longest-lived session instead of the idlest)."""
+        lru = next(iter(self.clients))
+        del self.clients[lru]
+        tracer.count("vsr.session_evictions")
+
+    def _shed_request(self, h: Header, reason: str) -> None:
+        """Admission shed: answer with a retryable BUSY (the client backs
+        off and resends — distinct from EVICTION, which kills the
+        session). Shedding at the door costs one header; queueing past
+        saturation costs unbounded queue-wait for everyone."""
+        tracer.count("vsr.sheds")
+        tracer.count(f"vsr.sheds.{reason}")
+        busy = hdr.make(
+            Command.BUSY, self.cluster, client=h["client"],
+            request=h["request"], replica=self.replica, view=self.view,
+        )
+        self.bus.send_to_client(h["client"], Message(busy).seal())
+
+    def _admission_full(self) -> Optional[str]:
+        """Shed reason when the door is saturated, else None. Queue-depth
+        bound always armed; the perceived-p99 bound only when configured
+        (its state is refreshed at tick granularity, see tick())."""
+        if len(self.request_queue) >= self.config.request_queue_max:
+            return "queue_full"
+        if self._latency_shed:
+            return "latency"
+        return None
 
     def _append_request(self, msg: Message) -> None:
         if msg.lifecycle is None and tracer.enabled():
@@ -881,7 +970,16 @@ class Replica:
             msg.lifecycle = tracer.op_begin()
             tracer.op_stamp(msg.lifecycle, tracer.OP_ARRIVE)
         if len(self.pipeline) >= self.config.pipeline_max:
+            h = msg.header
+            if h["operation"] != Operation.RECONFIGURE:
+                # RECONFIGURE is exempt: operator control plane, already
+                # bounded to one in-flight copy by its dedupe.
+                reason = self._admission_full()
+                if reason is not None:
+                    self._shed_request(h, reason)
+                    return
             self.request_queue.append(msg)
+            self._queued_req[int(h["client"])] = int(h["request"])
             return
         self._primary_prepare(msg)
 
@@ -1210,7 +1308,9 @@ class Replica:
             if not self._checkpoint_guarded():
                 break
         while self.request_queue and len(self.pipeline) < self.config.pipeline_max:
-            self._primary_prepare(self.request_queue.pop(0))
+            queued = self.request_queue.popleft()
+            self._queued_req.pop(int(queued.header["client"]), None)
+            self._primary_prepare(queued)
         if tracer.enabled():
             # Pipeline-pressure gauges: prepare pipeline, client request
             # backlog, and ops staged through the commit executor.
@@ -2602,7 +2702,8 @@ class Replica:
         self.status = STATUS_NORMAL
         self.log_view = v
         self.pipeline = []
-        self.request_queue = []
+        self.request_queue = deque()
+        self._queued_req = {}
         # Session-judgement floor: ops inherited from the previous view may
         # hold registers our client table hasn't applied yet — eviction
         # decisions wait until they commit (see on_request).
@@ -2990,11 +3091,18 @@ class Replica:
                 }
             if operation == Operation.REGISTER:
                 if len(self.clients) >= self.config.clients_max:
-                    self._evict_oldest_client()
+                    self._evict_lru_client()
                 self.clients[client] = ClientSession(session=op_num)
+                tracer.gauge("vsr.sessions", len(self.clients))
             sess = self.clients.get(client)
             if sess is not None:
                 sess.request = h["request"]
+                # LRU maintenance: this commit makes the session the most
+                # recently active — move it to the dict's end (O(1); a
+                # fresh REGISTER insert is already there). Replicated:
+                # applied at commit in op order on every replica.
+                sess.last_op = int(op_num)
+                self.clients[client] = self.clients.pop(client)
                 # build_reply=False: _stage_emit fills this in right after
                 # this tail returns; a resend in the window simply gets
                 # nothing (indistinguishable from reply loss — the client

@@ -36,6 +36,11 @@ CLIENT_ENTRY_DTYPE = np.dtype(
     [
         ("client_lo", "<u8"), ("client_hi", "<u8"),
         ("session", "<u8"),
+        # Op of the session's last committed request: the replicated LRU
+        # key — install() rebuilds the client dict sorted by it, so the
+        # eviction order survives checkpoint round-trips byte-identically
+        # on every replica (rows themselves stay sorted by client id).
+        ("last_op", "<u8"),
         ("request", "<u4"),
         ("reply_len", "<u4"),
     ]
@@ -175,15 +180,18 @@ def encode(replica) -> bytes:
         raw = sess.reply.to_bytes() if sess.reply is not None else b""
         client_rows[i]["client_lo"], client_rows[i]["client_hi"] = _split(cid)
         client_rows[i]["session"] = sess.session
+        client_rows[i]["last_op"] = sess.last_op
         client_rows[i]["request"] = sess.request
         client_rows[i]["reply_len"] = len(raw)
         reply_blobs.append(raw)
 
     sections = dict(
-        # v5: config_epoch/slot_epochs (r5), qi query tree, per-tree
-        # compaction-job descriptors. No migration path from v4 — data
-        # files are not carried across builds; the bump is diagnostic.
-        version=np.uint32(5),
+        # v6: client_table gains last_op (front-door LRU eviction order,
+        # ISSUE 9). v5: config_epoch/slot_epochs (r5), qi query tree,
+        # per-tree compaction-job descriptors. No migration path between
+        # versions — data files are not carried across builds; the bump
+        # is diagnostic.
+        version=np.uint32(6),
         account_count=np.int64(count),
         acc_key_hi=sm.acc_key["hi"][:count], acc_key_lo=sm.acc_key["lo"][:count],
         acc_ud128_lo=sm.acc_user_data_128_lo[:count],
@@ -419,10 +427,16 @@ def install(replica, blob: bytes, rebuild_bloom: bool = True,
     clients: Dict[int, ClientSession] = {}
     for rec in z["client_table"]:
         sess = ClientSession(session=int(rec["session"]))
+        sess.last_op = int(rec["last_op"])
         sess.request = int(rec["request"])
         rlen = int(rec["reply_len"])
         if rlen:
             sess.reply = Message.from_bytes(replies[offset : offset + rlen])
             offset += rlen
         clients[_join(rec["client_lo"], rec["client_hi"])] = sess
-    replica.clients.update(clients)
+    # Rebuild in LRU order (rows are stored sorted by client id for byte
+    # determinism; dict insertion order must be recency order — replica
+    # _evict_lru_client pops the front). last_op is unique per session
+    # (one op commits one request); the id tiebreak is belt-and-braces.
+    for cid in sorted(clients, key=lambda c: (clients[c].last_op, c)):
+        replica.clients[cid] = clients[cid]
