@@ -46,7 +46,7 @@ class DummyBus:
 
 
 def main(backend="numpy", batches=40, overlap=True, store_async=True,
-         warmup=2):
+         warmup=2, commit_depth=0):
     tracer.enable()
     # Compile-count guard (tidy/jaxlint.py CompileRegistry): after the
     # warmup batches the measured window must be retrace-free — any new
@@ -89,7 +89,10 @@ def main(backend="numpy", batches=40, overlap=True, store_async=True,
         replica.wal_writer = WalWriter(storage, posts.append)
         replica.journal.writer = replica.wal_writer
     if overlap:
-        replica.attach_executor(posts.append)
+        # commit_depth=0: adaptive (accelerator → min(pipeline_max, 4),
+        # host backends → 1); depth=N on the command line forces — the
+        # cross-batch window A/B and its occupancy section below.
+        replica.attach_executor(posts.append, commit_depth=commit_depth)
     if store_async:
         replica.attach_store_executor(posts.append)
 
@@ -191,6 +194,18 @@ def main(backend="numpy", batches=40, overlap=True, store_async=True,
     wall0 = time.perf_counter()
     with tracer.span("server.total"):
         for m in msgs:
+            # Feed with pipeline backpressure: past pipeline_max the
+            # round-14 front door sheds with BUSY (one backlog slot per
+            # session), and a shed batch would silently vanish from the
+            # profile — pace the feed like a real client's flow control
+            # instead. A fast backend never waits here; a slow one keeps
+            # the prepare pipeline exactly full.
+            while (
+                len(replica.pipeline) >= replica.config.pipeline_max
+                or replica.request_queue
+            ):
+                pump()
+                time.sleep(0.0002)
             # Ingress verification runs here exactly as bus.read_message
             # does on the server, so the stage table attributes it too.
             with tracer.span("stage.parse"):
@@ -210,6 +225,10 @@ def main(backend="numpy", batches=40, overlap=True, store_async=True,
     assert len(bus.replies) - n0 == batches, (len(bus.replies) - n0, batches)
 
     snap = tracer.snapshot()
+    # Every reply above is a genuine commit: the paced feed must never
+    # trip the admission door (a BUSY shed would count as a reply and
+    # silently shrink the measured op set).
+    assert snap.get("vsr.sheds", {}).get("count", 0) == 0, snap.get("vsr.sheds")
     # Dedup invariant 1: the registry's server.total span IS the wall
     # measurement (one clock, one source of truth) — the ad-hoc
     # time.perf_counter pair exists only to cross-check it.
@@ -220,7 +239,8 @@ def main(backend="numpy", batches=40, overlap=True, store_async=True,
     new_compiles = compile_registry.total_delta(compile_snap)
 
     print(f"backend={backend} batches={batches} overlap={overlap} "
-          f"store_async={store_async} warmup={warmup}")
+          f"store_async={store_async} warmup={warmup}"
+          + (f" commit_depth={replica.commit_depth}" if overlap else ""))
     print(f"client marshal: {marshal_s / (batches + warmup) * 1e3:.2f} ms/batch")
     print(f"client seal:    {seal_s / (batches + warmup) * 1e3:.2f} ms/batch")
     print(f"server total:   {total_ms / batches:.2f} ms/batch "
@@ -410,6 +430,38 @@ def main(backend="numpy", batches=40, overlap=True, store_async=True,
                 f"sum to perceived ({perceived['mean_ms']:.3f} ms)"
             )
 
+    # Cross-batch commit-window occupancy (docs/COMMIT_PIPELINE.md):
+    # mean in-flight dispatched batches, the exact per-depth histogram
+    # (one sample per processed batch), and the dispatch→finish gap —
+    # the window the depth-N pipeline exists to keep open. The zero-
+    # compiles assert above already ran: the scratch ring must introduce
+    # no per-depth shapes, so depth>1 stays retrace-free by the same
+    # gate.
+    flat = lifecycle["flat"]
+    if overlap and "commit_inflight_mean" in flat:
+        print(f"\npipeline occupancy (commit window, depth="
+              f"{flat.get('commit_depth', 1.0):.0f}):")
+        print(f"  in-flight mean {flat['commit_inflight_mean']:.2f}  "
+              f"max {flat.get('commit_inflight_max', 0):.0f}  "
+              f"p99 {flat.get('commit_inflight_p99', 0.0):.0f}")
+        depth_rows = sorted(
+            (int(k.rsplit(".d", 1)[1]), v["count"])
+            for k, v in snap.items()
+            if k.startswith("pipeline.commit.inflight.d")
+        )
+        if depth_rows:
+            total_n = sum(n for _, n in depth_rows)
+            print("  per-batch depth histogram: " + "  ".join(
+                f"{d}:{n} ({100.0 * n / total_n:.0f}%)"
+                for d, n in depth_rows
+            ))
+        record["commit_inflight_mean"] = flat["commit_inflight_mean"]
+        gap = snap.get("device.step.create_transfers_fast")
+        if gap and gap.get("count"):
+            print(f"  dispatch→finish gap: p50 {gap['p50_us'] / 1e3:.2f} ms  "
+                  f"p99 {gap['p99_us'] / 1e3:.2f} ms "
+                  f"({gap['count']} dispatches)")
+
     # Device-step profiler: per-jit-entry device time + transfer bytes
     # (numpy backend never dispatches, so the table is jax-only).
     dev_rows = {
@@ -455,12 +507,20 @@ def main(backend="numpy", batches=40, overlap=True, store_async=True,
 
 if __name__ == "__main__":
     _args = sys.argv[1:]
+    _depth = next(
+        (int(a.split("=", 1)[1]) for a in _args if a.startswith("depth=")), 0
+    )
     main(
         backend=next(
             (a for a in _args
-             if a not in ("serial-store", "async-store", "serial-commit")),
+             if a not in ("serial-store", "async-store", "serial-commit")
+             and not a.startswith("depth=")),
             "numpy",
         ),
         overlap="serial-commit" not in _args,
         store_async="serial-store" not in _args,
+        commit_depth=_depth,
+        # Device-merge + deep-window runs need the warmup to cover a
+        # flush cycle (see the warmup comment above).
+        warmup=8 if any(a == "jax" for a in _args) else 2,
     )

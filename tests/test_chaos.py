@@ -486,6 +486,21 @@ class TestChaosScenarios:
         assert res.extra["wal_replay_ops"] > 0  # scraped from /metrics
         assert res.extra["acked_tx_before_kill"] > 0
 
+    def test_kill_restart_real_process_depth8(self):
+        """Kill/restart with the cross-batch commit window wide open
+        (--commit-depth=8, jax backend so the split-phase dispatch path
+        is live): a SIGKILL drops whatever the window held on the floor,
+        and recovery must replay the WAL cleanly — acked transfers
+        durable, first post-restart commit at the tip."""
+        res = chaos.scenario_kill_restart_process(
+            batches_before=12, batches_after=8, backend="jax",
+            server_args=("--commit-depth=8",),
+        )
+        d = res.to_dict()
+        assert d["recovery_time_s"] > 0
+        assert res.extra["wal_replay_ops"] > 0
+        assert res.extra["acked_tx_before_kill"] > 0
+
     def test_run_all_lenient_fails_closed_on_process_error(self, monkeypatch):
         """A broken real-process kill/restart must not let the sim twin's
         (much smaller) metrics stand in for it under the gate: lenient

@@ -452,7 +452,8 @@ class TestOverlappedPipeline:
 
     OPS = 40  # past two TEST_MIN checkpoint intervals (16)
 
-    def _drive(self, overlap: bool, hash_log=None, store_async: bool = False):
+    def _drive(self, overlap: bool, hash_log=None, store_async: bool = False,
+               sm_backend: str = "numpy", commit_depth: int = 0):
         from tigerbeetle_tpu.testing.hash_log import attach_to_cluster
         from tigerbeetle_tpu.tidy import runtime as tidy_runtime
         from tigerbeetle_tpu.vsr.clock import Clock, DeterministicTime
@@ -462,7 +463,8 @@ class TestOverlappedPipeline:
         # BEFORE construction so the stage conditions are order-tracked.
         tidy_runtime.enable()
         cl = Cluster(
-            replica_count=3, seed=9, overlap=overlap, store_async=store_async
+            replica_count=3, seed=9, overlap=overlap, store_async=store_async,
+            sm_backend=sm_backend, commit_depth=commit_depth,
         )
         # Freeze wall time (tick_ns=0): prepare timestamps then derive
         # from the op stream alone, so the two runs' committed BYTES can
@@ -576,6 +578,33 @@ class TestOverlappedPipeline:
         overlap = self._drive(overlap=True, hash_log=check)
         check.close()
         self._check_runs_identical(serial, overlap)
+
+    def test_depth8_window_vs_serial_cluster_identical(self, tmp_path):
+        """Cross-batch pipelining at the full protocol depth through a
+        3-replica cluster on the jax backend (the split-phase device
+        path actually dispatches there): hash_log chains and checkpoint
+        trailer digests must match a serial jax run byte-for-byte. The
+        window forms on backups — journal commits arrive in bursts via
+        the piggybacked commit number — while the primary's one-client
+        stream keeps the op order identical across runs."""
+        from tigerbeetle_tpu.lsm.store import NativeU128Map, _hostops
+        from tigerbeetle_tpu.models.state_machine import make_u128_index
+        from tigerbeetle_tpu.testing.hash_log import HashLog
+
+        if _hostops() is None or not isinstance(
+            make_u128_index(64), NativeU128Map
+        ):
+            pytest.skip("split-phase dispatch needs the native staging shim")
+        path = str(tmp_path / "hash.log")
+        create = HashLog(path, "create")
+        serial = self._drive(overlap=False, hash_log=create, sm_backend="jax")
+        create.close()
+        check = HashLog(path, "check")
+        deep = self._drive(
+            overlap=True, hash_log=check, sm_backend="jax", commit_depth=8
+        )
+        check.close()
+        self._check_runs_identical(serial, deep)
 
 
 class TestAsyncStoreStage:

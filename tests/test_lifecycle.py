@@ -92,6 +92,31 @@ def test_scripted_decomposition_exact(traced):
     assert s["flat"]["lifecycle_perceived_p50_ms"] == pytest.approx(18.0, rel=0.13)
 
 
+def test_commit_inflight_flat_keys(traced):
+    """The cross-batch commit-window occupancy export: raw-depth
+    histogram → commit_inflight_mean/max/p99, plus the configured depth
+    from the pipeline.commit.depth_config gauge (recorded so A/Bs can
+    see which depth the adaptive default selected)."""
+    for d in (1, 2, 3, 4, 4, 4):
+        tracer.observe("pipeline.commit.inflight_depth", d)
+    tracer.gauge("pipeline.commit.depth_config", 4)
+    flat = tracer.lifecycle_summary()["flat"]
+    assert flat["commit_inflight_mean"] == pytest.approx(3.0)
+    assert flat["commit_inflight_max"] == 4
+    # Histogram percentile in RAW depth units (12.5% bucket resolution).
+    assert flat["commit_inflight_p99"] == pytest.approx(4.0, rel=0.13)
+    assert flat["commit_depth"] == 4.0
+
+
+def test_commit_inflight_absent_without_samples(traced):
+    """No window samples (serial commits, numpy backend before any op):
+    the flat export omits the occupancy keys rather than fabricating
+    zeros a gate would then compare against."""
+    flat = tracer.lifecycle_summary()["flat"]
+    assert "commit_inflight_mean" not in flat
+    assert "commit_depth" not in flat
+
+
 def test_partial_stamps_skip_components(traced):
     """A journal-path op (no arrival/reply) contributes only the
     components whose both stamps landed — never garbage."""

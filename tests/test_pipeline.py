@@ -114,19 +114,53 @@ class TestCommitExecutor:
 
         def process(job):
             held["job"] = job
-            return None, [], True  # hold (double-buffered device shape)
+            return None, [], True  # hold (dispatch-window device shape)
 
         def flush():
             j = held.pop("job")
             j["flushed"] = True
             ex.complete(j)
-            return None, True
+            return None, [], True
 
         ex = CommitExecutor(process=process, post=post, flush=flush)
         ex.submit({"op": 1})
         ex.drain()
         j = ex.pop_done()
         assert j is not None and j["flushed"]
+        ex.stop()
+
+    def test_flush_fault_parks_with_leftovers_requeued(self):
+        """A mid-window fault during flush: the faulted job publishes,
+        the unexecuted window jobs come back as leftovers at the queue
+        head, and the stage parks until reset()."""
+        held = []
+        posts, post = self._posts()
+        ex = None
+
+        def process(job):
+            held.append(job)
+            return None, [], True  # every job held in the window
+
+        def flush():
+            if len(held) < 3:
+                # The queue drained mid-submission: keep holding until
+                # the whole window is resident (deterministic fault
+                # point regardless of worker scheduling).
+                return None, [], True
+            bad, rest = held[0], held[1:]
+            held.clear()
+            bad["fault"] = "boom"
+            return bad, rest, False
+
+        ex = CommitExecutor(process=process, post=post, flush=flush)
+        for op in (1, 2, 3):
+            ex.submit({"op": op})
+        ex.drain()
+        assert ex.parked
+        pub = ex.pop_done()
+        assert pub is not None and pub["op"] == 1 and pub["fault"] == "boom"
+        leftovers = ex.reset()
+        assert [j["op"] for j in leftovers] == [2, 3]
         ex.stop()
 
     def test_poison_on_unexpected_exception(self):
@@ -439,9 +473,131 @@ class TestSplitPhaseDispatch:
         before = np.asarray(sm.state.debits_posted).copy()
         h = sm.create_transfers_dispatch(self._batch(np.arange(400, 404)), 600)
         assert h is not None
-        sm.create_transfers_abandon(h)
+        sm.create_transfers_abandon_all()
         after = np.asarray(sm.state.debits_posted)
         assert np.array_equal(before, after)
         # The same batch re-executes cleanly through the single-phase path.
         out = sm.create_transfers(self._batch(np.arange(400, 404)), timestamp=600)
         assert len(out) == 0
+
+
+class TestDispatchWindow:
+    """Depth-N split-phase window (cross-batch commit pipelining): up to
+    DISPATCH_WINDOW_MAX outstanding handles, a scratch ring that must not
+    corrupt in-flight batches, and a whole-window abandon that restores
+    the state token to the oldest live base."""
+
+    _sm = TestSplitPhaseDispatch._sm
+    _batch = staticmethod(TestSplitPhaseDispatch._batch)
+
+    @pytest.mark.parametrize("depth", [2, 4, 8])
+    def test_deep_window_matches_serial(self, depth):
+        """`depth` batches dispatched before the first finish: every
+        result and the stored state must be byte-identical to the
+        single-phase run. Distinct amounts per batch make scratch-ring
+        aliasing (a later dispatch overwriting an in-flight batch's
+        staged columns) visible as result/balance divergence."""
+        sm, ref = self._sm(), self._sm()
+        batches = [
+            self._batch(np.arange(1000 + 100 * i, 1000 + 100 * i + 4),
+                        amount=1 + i)
+            for i in range(depth)
+        ]
+        handles = []
+        for i, b in enumerate(batches):
+            h = sm.create_transfers_dispatch(b, 900 + 10 * i)
+            assert h is not None, f"batch {i} refused below the window cap"
+            handles.append(h)
+        outs = [sm.create_transfers_finish(h) for h in handles]
+        refs = [
+            ref.create_transfers(b, timestamp=900 + 10 * i)
+            for i, b in enumerate(batches)
+        ]
+        for out, r in zip(outs, refs):
+            assert out.tobytes() == r.tobytes()
+        for ident in (1, 2):
+            la = sm.lookup_accounts(
+                np.array([ident], np.uint64), np.array([0], np.uint64)
+            )
+            lb = ref.lookup_accounts(
+                np.array([ident], np.uint64), np.array([0], np.uint64)
+            )
+            assert la.tobytes() == lb.tobytes()
+
+    def test_window_cap_refuses_not_corrupts(self):
+        """Dispatch past DISPATCH_WINDOW_MAX refuses (a pipeline stall);
+        after finishing one batch the window accepts again."""
+        from tigerbeetle_tpu.models.state_machine import DISPATCH_WINDOW_MAX
+
+        sm = self._sm()
+        handles = []
+        for i in range(DISPATCH_WINDOW_MAX):
+            h = sm.create_transfers_dispatch(
+                self._batch(np.arange(2000 + 10 * i, 2000 + 10 * i + 2)),
+                700 + 10 * i,
+            )
+            assert h is not None
+            handles.append(h)
+        full = sm.create_transfers_dispatch(
+            self._batch(np.array([3000, 3001])), 900
+        )
+        assert full is None, "window-full dispatch must refuse"
+        out0 = sm.create_transfers_finish(handles[0])
+        assert len(out0) == 0
+        h = sm.create_transfers_dispatch(
+            self._batch(np.array([3000, 3001])), 900
+        )
+        assert h is not None
+        for hh in handles[1:] + [h]:
+            assert len(sm.create_transfers_finish(hh)) == 0
+
+    def test_abandon_all_restores_oldest_live_base(self):
+        """A whole-window reclaim (grid-repair park) rolls the state
+        token back past every dispatched kernel in one step; the same
+        batches then re-execute cleanly with identical results."""
+        sm, ref = self._sm(), self._sm()
+        before = np.asarray(sm.state.debits_posted).copy()
+        batches = [
+            self._batch(np.arange(4000 + 100 * i, 4000 + 100 * i + 3))
+            for i in range(4)
+        ]
+        for i, b in enumerate(batches):
+            assert sm.create_transfers_dispatch(b, 500 + 10 * i) is not None
+        sm.create_transfers_abandon_all()
+        assert not sm._ct_pending
+        assert np.array_equal(before, np.asarray(sm.state.debits_posted))
+        for i, b in enumerate(batches):
+            out = sm.create_transfers(b, timestamp=500 + 10 * i)
+            r = ref.create_transfers(b, timestamp=500 + 10 * i)
+            assert out.tobytes() == r.tobytes()
+
+    def test_abandon_all_after_mid_window_bail_keeps_refired_state(self):
+        """A gen-fence mid-window (bail refire) makes the remaining
+        handles stale: abandon_all must NOT restore a stale base — the
+        refire already rebuilt the correct state below it."""
+        sm, ref = self._sm(), self._sm()
+        b1 = self._batch(np.arange(5000, 5004))
+        b2 = self._batch(np.arange(5100, 5104))
+        b3 = self._batch(np.arange(5200, 5204))
+        h1 = sm.create_transfers_dispatch(b1, 600)
+        h2 = sm.create_transfers_dispatch(b2, 610)
+        h3 = sm.create_transfers_dispatch(b3, 620)
+        assert None not in (h1, h2, h3)
+        # Simulate a chain break at h1's finish (what a device bail
+        # does): rollback + gen bump, then the refire applies b1 via the
+        # single-phase path. h2/h3 are now stale.
+        sm.state = h1["prev_state"]
+        sm._state_gen += 1
+        out1 = sm.create_transfers_finish(h1)  # refires single-phase
+        sm.create_transfers_abandon_all()  # h2, h3: stale — no restore
+        assert not sm._ct_pending
+        ref1 = ref.create_transfers(b1, timestamp=600)
+        assert out1.tobytes() == ref1.tobytes()
+        # b1's effects must survive the abandon; b2/b3 re-execute clean.
+        for i, b in enumerate((b2, b3)):
+            out = sm.create_transfers(b, timestamp=610 + 10 * i)
+            r = ref.create_transfers(b, timestamp=610 + 10 * i)
+            assert out.tobytes() == r.tobytes()
+        la = sm.lookup_accounts(np.array([1], np.uint64), np.array([0], np.uint64))
+        lb = ref.lookup_accounts(np.array([1], np.uint64), np.array([0], np.uint64))
+        assert la.tobytes() == lb.tobytes()

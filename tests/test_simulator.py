@@ -30,7 +30,21 @@ def test_vopr_big_batch_schedule():
     assert sim.workload.largest_batch == 8190
 
 
-def test_overlap_stage_gates_on_grid_repair_and_checkpoint():
+@pytest.mark.parametrize(
+    "sm_backend,commit_depth",
+    [
+        ("numpy", 0),
+        # jax + depth 8: the split-phase dispatch window forms on the
+        # backup (journal commits arrive in bursts), so the query fault
+        # parks the stage MID-WINDOW — the reclaim must abandon every
+        # dispatched-but-unfinished handle (one state-token rollback)
+        # before the repair, and the retry must re-execute cleanly.
+        ("jax", 8),
+    ],
+)
+def test_overlap_stage_gates_on_grid_repair_and_checkpoint(
+    sm_backend, commit_depth
+):
     """Gating correctness for the overlapped commit stage: a seeded
     schedule corrupts a grid block on a backup so a committed query
     FAULTS inside the executor stage, while later ops are already staged
@@ -49,11 +63,23 @@ def test_overlap_stage_gates_on_grid_repair_and_checkpoint():
 
     from tigerbeetle_tpu.tidy import runtime as tidy_runtime
 
+    if sm_backend == "jax":
+        from tigerbeetle_tpu.lsm.store import NativeU128Map, _hostops
+        from tigerbeetle_tpu.models.state_machine import make_u128_index
+
+        if _hostops() is None or not isinstance(
+            make_u128_index(64), NativeU128Map
+        ):
+            pytest.skip("split-phase dispatch needs the native staging shim")
+
     # The park/reclaim/repair/resume schedule is the nastiest cross-thread
     # interleaving in the pipeline — run it under the tidy runtime's
     # thread-affinity and lock-order assertions (no-op in production).
     tidy_runtime.enable()
-    cl = Cluster(replica_count=3, seed=77, overlap=True)
+    cl = Cluster(
+        replica_count=3, seed=77, overlap=True,
+        sm_backend=sm_backend, commit_depth=commit_depth,
+    )
     try:
         # Record every replica's execution order (the commit event fires
         # on the executor thread, in execution order).

@@ -157,7 +157,8 @@ def cmd_start(args) -> int:
         if si:
             sys.setswitchinterval(float(si))
     server = ReplicaServer(
-        replica, addresses, overlap=overlap, store_async=store_async
+        replica, addresses, overlap=overlap, store_async=store_async,
+        commit_depth=args.commit_depth,
     )
 
     from tigerbeetle_tpu import tracer
@@ -401,6 +402,8 @@ def cmd_benchmark(args) -> int:
             server_args.append("--serial-commit")
         if args.serial_store:
             server_args.append("--serial-store")
+        if args.commit_depth:
+            server_args.append(f"--commit-depth={args.commit_depth}")
         proc = subprocess.Popen(
             server_args + [path],
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
@@ -592,6 +595,15 @@ def cmd_benchmark(args) -> int:
                     result["flight_dumps"] = lc.get("flight", {}).get("dumps", 0)
                 except (OSError, ValueError) as e:
                     print(f"lifecycle scrape failed: {e}", file=sys.stderr)
+                if "commit_inflight_mean" in result:
+                    # Cross-batch commit pipelining occupancy (BENCH_JSON
+                    # carries the same keys machine-readably).
+                    print(
+                        f"commit window: depth="
+                        f"{result.get('commit_depth', 1.0):.0f} "
+                        f"inflight mean={result['commit_inflight_mean']:.2f}"
+                        f" max={result.get('commit_inflight_max', 0):.0f}"
+                    )
 
             # Query phase (reference benchmark_load.zig: account queries
             # after the load; prints query latency p90).
@@ -682,6 +694,13 @@ def main(argv=None) -> int:
     s.add_argument("--serial-commit", action="store_true",
                    help="disable the overlapped commit stage (execute "
                         "inline on the event loop)")
+    s.add_argument("--commit-depth", type=int, default=0,
+                   help="cross-batch commit pipelining: max device "
+                        "batches in flight through the commit stage "
+                        "(1 = no dispatch-ahead, up to pipeline_max=8; "
+                        "0 = adaptive — min(pipeline_max, 4) on "
+                        "accelerator backends, 1 where the serial path "
+                        "wins; TIGERBEETLE_TPU_COMMIT_DEPTH forces)")
     s.add_argument("--serial-store", action="store_true",
                    help="disable the async LSM store stage (groove/index "
                         "writes + compaction beats inline after each op)")
@@ -759,6 +778,9 @@ def main(argv=None) -> int:
     b.add_argument("--serial-commit", action="store_true",
                    help="run the server with the overlapped commit stage "
                         "disabled (A/B comparison)")
+    b.add_argument("--commit-depth", type=int, default=0,
+                   help="force the server's cross-batch commit-window "
+                        "depth (0 = adaptive; forced-depth A/Bs)")
     b.add_argument("--serial-store", action="store_true",
                    help="run the server with the async store stage "
                         "disabled (A/B comparison)")

@@ -27,7 +27,9 @@ import numpy as np
 
 from tigerbeetle_tpu import tracer, types
 from tigerbeetle_tpu.tidy import runtime as tidy_runtime
-from tigerbeetle_tpu.constants import Config, PRODUCTION
+from tigerbeetle_tpu.constants import (
+    Config, PIPELINE_PREPARE_QUEUE_MAX, PRODUCTION,
+)
 from tigerbeetle_tpu.flags import AccountFlags, TransferFlags
 from tigerbeetle_tpu.lsm.store import (
     KEY_DTYPE,
@@ -65,6 +67,14 @@ _EXACT_ACCOUNT_FLAGS = np.uint32(
     | AccountFlags.CREDITS_MUST_NOT_EXCEED_DEBITS
     | AccountFlags.HISTORY
 )
+
+# Hard cap on dispatched-but-unfinished split-phase handles — the
+# commit pipeline's cross-batch window (vsr/replica.py commit_depth)
+# can never exceed it. Equals the protocol's prepare-queue depth AND
+# the dispatch scratch ring size: slot i and slot i+WINDOW share host
+# staging buffers, so a slot is only rewritten after its previous
+# occupant's kernel has been finished (finish syncs before returning).
+DISPATCH_WINDOW_MAX = PIPELINE_PREPARE_QUEUE_MAX
 
 
 class _LazyDict(dict):
@@ -302,9 +312,24 @@ class StateMachine:
         # vsr/pipeline.py): FIFO of outstanding handles whose kernels are
         # dispatched but not yet synced (finish pops strictly in dispatch
         # order); _state_gen fences handles that chained off a state token
-        # a serial bail rolled back.
-        self._ct_pending: list = []
-        self._state_gen = 0
+        # a serial bail rolled back. Depth is bounded by
+        # DISPATCH_WINDOW_MAX (dispatch refuses past it — a pipeline
+        # stall, never corruption).
+        self._ct_pending: list = []  # tidy: owner=commit
+        self._state_gen = 0  # tidy: owner=commit
+        # Dispatch scratch ring: one host staging-buffer slot per
+        # in-flight generation (keyed seq % DISPATCH_WINDOW_MAX), each
+        # lazily holding the padded SoA block per pow-2 bucket size.
+        # Shapes depend ONLY on the bucket, never on the ring slot or
+        # window depth, so the compile-count gate is depth-independent.
+        # A slot is reused only once its previous occupant finished
+        # (ring size == the window cap), so even a zero-copy h2d alias
+        # could never see a concurrent rewrite.
+        # tidy: owner=commit — filled and handed to the kernel on the commit thread only
+        self._disp_scratch: list = [
+            {} for _ in range(DISPATCH_WINDOW_MAX)
+        ]
+        self._disp_seq = 0  # tidy: owner=commit
 
         # telemetry: how many batches took which path
         self.stats = {
@@ -1051,6 +1076,11 @@ class StateMachine:
         ordinary create_transfers at its op's turn."""
         if self._ops is None or self.mesh is not None:
             return None
+        if len(self._ct_pending) >= DISPATCH_WINDOW_MAX:
+            # Window full: refuse — the caller settles the oldest batch
+            # first (a pipeline stall, never corruption). Also keeps the
+            # scratch ring's slot-reuse distance ≥ the in-flight count.
+            return None
         events = np.atleast_1d(events)
         n = len(events)
         if n == 0:
@@ -1065,14 +1095,21 @@ class StateMachine:
         # route; bit 8: post/void of an id in this batch → serial.
         if bits & (1 | 2 | 8):
             return None
-        for pending in self._ct_pending:
+        if self._ct_pending:
             # An outstanding batch's OK ids are not in the bloom/index yet
             # (its store happens at finish): any id overlap (or a
             # post/void naming one) would mis-validate — refuse to
             # dispatch ahead. Conservative on id_lo alone: false positives
-            # only cost the overlap, never correctness.
-            if bool(np.isin(events["id_lo"], pending["id_lo"]).any()) or bool(
-                np.isin(events["pending_id_lo"], pending["id_lo"]).any()
+            # only cost the overlap, never correctness. One concatenated
+            # membership probe over every outstanding handle (two scans
+            # total), not two scans per handle — this runs per dispatch
+            # on the hot commit path at window depth up to 8.
+            outstanding = (
+                self._ct_pending[0]["id_lo"] if len(self._ct_pending) == 1
+                else np.concatenate([p["id_lo"] for p in self._ct_pending])
+            )
+            if bool(np.isin(events["id_lo"], outstanding).any()) or bool(
+                np.isin(events["pending_id_lo"], outstanding).any()
             ):
                 return None
         if bits & 4:
@@ -1155,20 +1192,48 @@ class StateMachine:
             self.commit_timestamp = int(ts[ok][-1])
         return _codes_to_results(codes)
 
-    def create_transfers_abandon(self, handle) -> None:
-        """Discard the NEWEST dispatched-but-unfinished handle (its op is
-        being requeued behind a grid repair): roll the state token back to
-        the pre-dispatch value and fence anything that chained off the
-        abandoned token."""
-        if not self._ct_pending or handle is not self._ct_pending[-1]:
+    def create_transfers_abandon_all(self) -> None:
+        """Discard EVERY dispatched-but-unfinished handle (depth-N window
+        reclaim behind a grid repair): roll the state token back to the
+        oldest LIVE handle's pre-dispatch value — live handles form a
+        suffix of the FIFO (gen only moves forward), and the oldest live
+        base is the state before any abandoned kernel in the current
+        chain ran. Stale handles' bases predate a rollback that already
+        happened below them (a bail refire rebuilt state past their
+        base), so restoring one would clobber the corrected state."""
+        if not self._ct_pending:
             return
-        self._ct_pending.pop()
-        tracer.device_finish("create_transfers_fast", handle.get("t_disp", 0))
-        if handle["gen"] == self._state_gen:
-            # A stale gen means an earlier bail already rolled the token
-            # back past this handle's base — restoring would clobber it.
-            self.state = handle["prev_state"]
+        live = next(
+            (h for h in self._ct_pending if h["gen"] == self._state_gen),
+            None,
+        )
+        for h in self._ct_pending:
+            tracer.device_finish("create_transfers_fast", h.get("t_disp", 0))
+        self._ct_pending.clear()
+        if live is not None:
+            self.state = live["prev_state"]
             self._state_gen += 1
+
+    def dispatch_depth_default(self) -> int:
+        """Adaptive cross-batch commit-window depth (vsr/replica.py
+        commit_depth): min(pipeline_max, 4) where dispatch-ahead buys
+        real overlap (an accelerator executes batch N+1 while the host
+        drains batch N's store/reply), 1 where the serial single-phase
+        path already wins (host-only backends, XLA-CPU — the "device"
+        work shares the host cores — and mesh-sharded execution, whose
+        kernels never take the split-phase path). --commit-depth /
+        TIGERBEETLE_TPU_COMMIT_DEPTH force either way."""
+        if self._ops is None or self.mesh is not None:
+            return 1
+        import jax
+
+        # Anything that is not the XLA-CPU backend is an accelerator
+        # (tpu, gpu, and plugin backends like axon): device compute
+        # genuinely overlaps the host's drain there. XLA-CPU shares the
+        # host cores, so dispatch-ahead only reorders work.
+        if jax.default_backend() != "cpu":
+            return min(self.config.pipeline_max, 4)
+        return 1
 
     def _create_transfers_staged(
         self, events: np.ndarray, timestamp: int, staged
@@ -1275,31 +1340,51 @@ class StateMachine:
         """Pack events into the kernel's SoA form, padded to a power-of-two
         bucket so each kernel compiles once per bucket size, not per batch
         length. Padding events carry a nonzero host code (never applied) and
-        are stripped from the results."""
+        are stripped from the results.
+
+        The padded block is written into the dispatch scratch ring's next
+        slot (one slot per in-flight generation, lazily allocated per
+        bucket size): the depth-N commit window stages up to
+        DISPATCH_WINDOW_MAX batches before the oldest finishes, and slot
+        reuse only comes around after that many later dispatches — by
+        which point the slot's previous occupant has synced. Bucket
+        shapes are the only shape axis, so the ring adds no compiles."""
         n = len(events)
         n_pad = 1 << max(4, (n - 1).bit_length())
+        scratch = self._disp_scratch[self._disp_seq % DISPATCH_WINDOW_MAX]
+        self._disp_seq += 1
 
-        def pad1(a, fill=0):
-            if len(a) == n:
-                out = np.full((n_pad, *a.shape[1:]), fill, dtype=a.dtype)
-                out[:n] = a
-                return out
-            return a
+        def pad1(name, a, fill=0):
+            if len(a) != n:
+                return a
+            out = scratch.get((name, n_pad))
+            if out is None:
+                out = scratch[(name, n_pad)] = np.empty(
+                    (n_pad, *a.shape[1:]), dtype=a.dtype
+                )
+            if n_pad != n:
+                out[n:] = fill  # padding rows stay inert under `fill`
+            out[:n] = a
+            return out
 
-        host_code_p = pad1(host_code, fill=int(TR.ID_MUST_NOT_BE_ZERO))
+        host_code_p = pad1("host_code", host_code, fill=int(TR.ID_MUST_NOT_BE_ZERO))
         b = self._ops.TransferBatch(
-            id=pad1(types.u64_pair_to_limbs(events["id_lo"], events["id_hi"])),
-            dr_slot=pad1(dr_slots.astype(np.int32), fill=-1),
-            cr_slot=pad1(cr_slots.astype(np.int32), fill=-1),
-            amount=pad1(types.u64_pair_to_limbs(events["amount_lo"], events["amount_hi"])),
+            id=pad1("id", types.u64_pair_to_limbs(events["id_lo"], events["id_hi"])),
+            dr_slot=pad1("dr_slot", dr_slots.astype(np.int32), fill=-1),
+            cr_slot=pad1("cr_slot", cr_slots.astype(np.int32), fill=-1),
+            amount=pad1(
+                "amount",
+                types.u64_pair_to_limbs(events["amount_lo"], events["amount_hi"]),
+            ),
             pending_id=pad1(
+                "pending_id",
                 types.u64_pair_to_limbs(events["pending_id_lo"], events["pending_id_hi"])
             ),
-            timeout=pad1(events["timeout"].astype(np.uint32)),
-            ledger=pad1(events["ledger"].astype(np.uint32)),
-            code=pad1(events["code"].astype(np.uint32)),
-            flags=pad1(events["flags"].astype(np.uint32)),
-            timestamp=pad1(types.u64_to_limbs(ts)),
+            timeout=pad1("timeout", events["timeout"].astype(np.uint32)),
+            ledger=pad1("ledger", events["ledger"].astype(np.uint32)),
+            code=pad1("code", events["code"].astype(np.uint32)),
+            flags=pad1("flags", events["flags"].astype(np.uint32)),
+            timestamp=pad1("timestamp", types.u64_to_limbs(ts)),
         )
         return b, host_code_p
 

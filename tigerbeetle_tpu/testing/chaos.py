@@ -640,6 +640,7 @@ def scenario_kill_restart_process(
     config: str = "development",
     backend: str = "numpy",
     timeout_s: float = 300.0,
+    server_args: Sequence[str] = (),
 ) -> ScenarioResult:
     """Kill/restart under load against a REAL replica process: format a
     FileStorage data file, `cli.py start` it, drive batched transfers,
@@ -668,7 +669,9 @@ def scenario_kill_restart_process(
         assert rc == 0
         port = probe_free_port(3100 + os.getpid() % 800)
         mport = probe_free_port(port + 1)
-        proc = _spawn_replica(path, port, mport, config, backend)
+        proc = _spawn_replica(
+            path, port, mport, config, backend, extra_args=server_args
+        )
         proc2 = None
         try:
             client = Client([("127.0.0.1", port)])
@@ -719,7 +722,9 @@ def scenario_kill_restart_process(
             # process boot + superblock open + WAL replay + listener up
             # are all part of how long the operator waits.
             t_restart = time.perf_counter()
-            proc2 = _spawn_replica(path, port, mport, config, backend)
+            proc2 = _spawn_replica(
+                path, port, mport, config, backend, extra_args=server_args
+            )
             t_listening = time.perf_counter()
 
             # First post-restart commit at the tip: the first accepted

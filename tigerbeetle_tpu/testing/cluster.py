@@ -194,6 +194,7 @@ class Cluster:
         standby_count: int = 0,
         overlap: bool = False,
         store_async: bool = False,
+        commit_depth: int = 0,
     ) -> None:
         # The sim main thread IS the event loop: stamp it so the runtime
         # affinity assertions (tidy/runtime.py, enabled by the
@@ -212,6 +213,9 @@ class Cluster:
         # StoreExecutor thread (async LSM store stage) to every replica.
         self.overlap = overlap
         self.store_async = store_async
+        # Cross-batch commit-window depth for overlap=True replicas
+        # (0 = adaptive; the depth-determinism guards force 2/4/8).
+        self.commit_depth = commit_depth
         from collections import deque
 
         self._exec_posts = deque()
@@ -261,7 +265,8 @@ class Cluster:
             # or retired (a dead replica must not keep applying
             # completions or sending through the live network).
             r.attach_executor(
-                lambda cb, _r=r: self._exec_posts.append((_r, cb))
+                lambda cb, _r=r: self._exec_posts.append((_r, cb)),
+                commit_depth=self.commit_depth,
             )
         if self.store_async:
             r.attach_store_executor(

@@ -839,6 +839,26 @@ def lifecycle_summary() -> dict:
         flat[key] = s["mean_ms"]
         flat[f"{key}_p50"] = s["p50_ms"]
         flat[f"{key}_p99"] = s["p99_ms"]
+    # Cross-batch commit-window occupancy (vsr/replica.py
+    # _stage_note_inflight): one raw-depth sample per processed batch —
+    # mean in-flight dispatched batches, the high-water, and the p99 of
+    # the per-depth histogram. commit_depth is the CONFIGURED window
+    # (pipeline.commit.depth_config gauge) so A/Bs across hosts can see
+    # which depth the adaptive default actually selected.
+    inflight = agg.get("pipeline.commit.inflight_depth")
+    if inflight is not None and inflight[0]:
+        n_if, total_if, max_if = inflight
+        flat["commit_inflight_mean"] = round(total_if / n_if, 3)
+        flat["commit_inflight_max"] = int(max_if)
+        h_if = hists.get("pipeline.commit.inflight_depth")
+        if h_if:
+            flat["commit_inflight_p99"] = float(
+                _hist_percentile(h_if, sum(h_if), 0.99)
+            )
+    with _registry_lock:
+        depth_cfg = _gauges.get("pipeline.commit.depth_config")
+    if depth_cfg is not None:
+        flat["commit_depth"] = float(depth_cfg)
     # Stage occupancy: mean prepares resident per pipeline stage (wait +
     # service of that stage), plus the whole arrive→reply window.
     occupancy.update(_stage_occupancy(

@@ -29,8 +29,9 @@ thread:
     `publish` (the faulted job, or a finish-fault marker for an op whose
     completion already went out) is made visible only AFTER the park
     flag is set, so the event loop's `reset()` cannot race it.
-  - `flush() -> (publish, ok)`: settle a held (double-buffered) job once
-    the queue runs dry.
+  - `flush() -> (publish, leftovers, ok)`: settle the held cross-batch
+    dispatch window (up to commit_depth jobs) once the queue runs dry;
+    `leftovers` are window jobs a mid-window fault left unexecuted.
   - `complete(job)` appends to the thread-safe done deque and pokes the
     event loop, which applies completions in op order via `pop_done()`.
 
@@ -70,7 +71,9 @@ class CommitExecutor:
         self,
         process: Callable[[dict], Tuple[Optional[dict], List[dict], bool]],
         post: Callable[[Callable[[], None]], None],
-        flush: Optional[Callable[[], Tuple[Optional[dict], bool]]] = None,
+        flush: Optional[
+            Callable[[], Tuple[Optional[dict], List[dict], bool]]
+        ] = None,
         notify: Optional[Callable[[], None]] = None,
     ) -> None:
         self._process = process
@@ -202,9 +205,9 @@ class CommitExecutor:
                     with self._cond:
                         queue_empty = not self._pending
                     if queue_empty and self._flush is not None:
-                        publish, ok = self._flush()
+                        publish, leftovers, ok = self._flush()
                         if not ok:
-                            self._publish_parked(publish, [])
+                            self._publish_parked(publish, leftovers)
             except Exception as e:  # noqa: BLE001 — fail-stop, never wedge
                 self._poison(e)
                 return

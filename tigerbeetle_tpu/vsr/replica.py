@@ -356,9 +356,21 @@ class Replica:
         # Jobs handed to the stage but not yet completion-applied, in op
         # order. commit_min advances only as completions are applied.
         self._staged: List[dict] = []
-        # Executor-thread-owned: the job whose device kernel is dispatched
-        # but not yet synced (double-buffered device path).
-        self._stage_pending: Optional[dict] = None
+        # Executor-thread-owned: the cross-batch commit window — jobs
+        # whose device kernels are dispatched but not yet synced, in op
+        # order (docs/COMMIT_PIPELINE.md cross-batch pipelining). Up to
+        # commit_depth batches ride here so batch N+1's dispatch overlaps
+        # batch N's finish → reply → store hand-off; finishes retire
+        # strictly from the left (op order), so hash_log chains, grid
+        # allocation order, and checkpoint bytes are depth-independent.
+        self._stage_window: Deque[dict] = deque()
+        # Max in-flight dispatched batches (1 = single-phase execution
+        # inside the stage; the pre-depth double-buffer ≡ 2). Set by
+        # attach_executor; bounded by the state machine's scratch ring.
+        self.commit_depth = 1
+        # High-water of the window depth (executor-thread-owned, read
+        # after quiesce by tests/benchmarks that assert overlap happened).
+        self.stage_inflight_max = 0
         self._stage_quiescing = False
         self._reply_builder: Optional[hdr.ReplyBuilder] = None
 
@@ -1447,20 +1459,47 @@ class Replica:
 
     STAGE_QUEUE_MAX = 16  # ops in flight through the stage
 
-    def attach_executor(self, post: Callable[[Callable[[], None]], None]) -> None:
+    def attach_executor(
+        self,
+        post: Callable[[Callable[[], None]], None],
+        commit_depth: int = 0,
+    ) -> None:
         """Wire the overlapped commit stage. `post` schedules a callback
         onto the replica's event loop thread (fail-stop guarded by the
         embedder). Tests and the deterministic simulator never call this:
-        executor=None selects the serial inline fallback."""
+        executor=None selects the serial inline fallback.
+
+        `commit_depth` sizes the cross-batch dispatch window (0 =
+        adaptive: TIGERBEETLE_TPU_COMMIT_DEPTH, else the state machine's
+        backend-aware default)."""
         from tigerbeetle_tpu.vsr.pipeline import CommitExecutor
 
         assert self.executor is None
+        self.commit_depth = self._resolve_commit_depth(commit_depth)
+        tracer.gauge("pipeline.commit.depth_config", self.commit_depth)
         self._reply_builder = hdr.ReplyBuilder()
         self.executor = CommitExecutor(
             process=self._stage_process,
             post=post,
             flush=self._stage_flush,
             notify=self._drain_stage_completions,
+        )
+
+    def _resolve_commit_depth(self, requested: int) -> int:
+        """Clamp an explicit depth, or pick the adaptive default. The cap
+        is the smaller of the protocol's prepare-queue depth and the
+        state machine's dispatch window (scratch-ring slots)."""
+        import os  # tidy: allow=env-read — operator tuning knob, fixed per process; every depth is byte-identical (determinism guard)
+
+        from tigerbeetle_tpu.models.state_machine import DISPATCH_WINDOW_MAX
+
+        if not requested:
+            env = os.environ.get("TIGERBEETLE_TPU_COMMIT_DEPTH")  # tidy: allow=env-read — operator tuning knob, fixed per process; every depth is byte-identical (determinism guard)
+            requested = int(env) if env else 0
+        if not requested:
+            requested = self.state_machine.dispatch_depth_default()
+        return max(
+            1, min(int(requested), self.config.pipeline_max, DISPATCH_WINDOW_MAX)
         )
 
     # --- deferred LSM store stage (vsr/pipeline.StoreExecutor) ----------
@@ -1650,46 +1689,95 @@ class Replica:
 
     def _stage_process(self, job: dict):
         """One stage step (executor thread): dispatch this op's device
-        work, then settle the held previous op (sync, store, reply,
-        compaction beat), then either hold this op (device path) or run
-        it in full. Returns (publish, leftovers, ok) for the executor;
-        ok=False parks the stage on a GridReadFault until the loop
-        repairs and resets."""
+        work into the cross-batch window, settle the oldest batches once
+        the window is at depth (sync, store, reply, compaction beat —
+        strictly in op order), and run non-dispatchable ops in full after
+        the whole window drains. Returns (publish, leftovers, ok) for the
+        executor; ok=False parks the stage on a GridReadFault until the
+        loop repairs and resets."""
         handle = None
-        try:
-            handle = self._stage_dispatch(job)
-        except GridReadFault:
-            # Dispatch is read-only: fall through to the full path, which
-            # will re-hit the fault at this op's proper turn.
-            handle = None
-        pend = self._stage_pending
-        if pend is not None:
-            self._stage_pending = None
-            publish, ok = self._stage_settle(pend, self._stage_exec_held)
-            if not ok:
-                if handle is not None:
-                    self.state_machine.create_transfers_abandon(handle)
-                # This job never executed: back to the queue head.
-                return publish, [job], False
+        if self.commit_depth > 1:
+            try:
+                handle = self._stage_dispatch(job)
+            except GridReadFault:
+                # Dispatch is read-only: fall through to the full path,
+                # which re-hits the fault at this op's proper turn.
+                handle = None
         if handle is not None:
-            # Double-buffered device path: the op's execution begins at
+            # Split-phase device path: the op's execution begins at
             # dispatch — the settle stamp must not overwrite it, so the
             # commit-queue wait excludes device time (device time itself
             # is the device-step profiler's dispatch→finish row).
             tracer.op_stamp_first(job.get("lc"), tracer.OP_EXEC_START)
             job["_handle"] = handle
-            self._stage_pending = job
+            self._stage_window.append(job)
+            self._stage_note_inflight(len(self._stage_window))
+            while len(self._stage_window) >= self.commit_depth:
+                head = self._stage_window.popleft()
+                publish, ok = self._stage_settle(head, self._stage_exec_held)
+                if not ok:
+                    return publish, self._stage_window_reclaim(), False
             return None, [], True
+        # Non-dispatchable op (routing depends on in-flight batches, a
+        # non-transfer op, host-only backend) or depth 1: it executes at
+        # its own turn, after every dispatched batch ahead of it settles
+        # — the id-overlap fence lands here as a window stall. The
+        # sample counts the held batches PLUS this op: they are all
+        # genuinely in flight until the window drains.
+        self._stage_note_inflight(len(self._stage_window) + 1)
+        publish, ok = self._stage_settle_window()
+        if not ok:
+            return publish, self._stage_window_reclaim() + [job], False
         publish, ok = self._stage_settle(job, self._stage_exec_full)
         return publish, [], ok
 
+    def _stage_note_inflight(self, depth: int) -> None:
+        """Occupancy sample, once per processed batch: how many batches
+        are in flight through the commit window at its dispatch (1 on the
+        serial/full path — the batch itself). Gauge for live scrapes,
+        histogram (raw depth units) for the per-depth distribution and
+        the benchmark's commit_inflight_mean."""
+        if depth > self.stage_inflight_max:
+            self.stage_inflight_max = depth
+        if tracer.enabled():
+            tracer.gauge("pipeline.commit.inflight", depth)
+            tracer.observe("pipeline.commit.inflight_depth", depth)
+            # Exact per-depth histogram (bounded: depth ≤ pipeline_max).
+            tracer.count(f"pipeline.commit.inflight.d{depth}")
+            # Re-asserted per batch so the configured depth survives a
+            # registry reset (profile windows reset mid-process).
+            tracer.gauge("pipeline.commit.depth_config", self.commit_depth)
+
+    def _stage_settle_window(self):
+        """Settle every window batch, oldest first. (publish, ok):
+        ok=False left the remaining window for _stage_window_reclaim."""
+        while self._stage_window:
+            head = self._stage_window.popleft()
+            publish, ok = self._stage_settle(head, self._stage_exec_held)
+            if not ok:
+                return publish, False
+        return None, True
+
+    def _stage_window_reclaim(self) -> List[dict]:
+        """A fault parked the stage mid-window: abandon every dispatched-
+        but-unfinished handle (one state-token rollback to the oldest
+        live base — sm.create_transfers_abandon_all) and hand the jobs
+        back, in op order, as executor leftovers for the loop's reclaim."""
+        if not self._stage_window:
+            return []
+        jobs = list(self._stage_window)
+        self._stage_window.clear()
+        for j in jobs:
+            j.pop("_handle", None)
+        self.state_machine.create_transfers_abandon_all()
+        return jobs
+
     def _stage_flush(self):
-        """Queue ran dry: settle the held double-buffered job."""
-        pend = self._stage_pending
-        if pend is None:
-            return None, True
-        self._stage_pending = None
-        return self._stage_settle(pend, self._stage_exec_held)
+        """Queue ran dry: settle the whole dispatch window."""
+        publish, ok = self._stage_settle_window()
+        if not ok:
+            return publish, self._stage_window_reclaim(), False
+        return None, [], True
 
     def _stage_exec_full(self, job: dict) -> None:
         job["spec"] = self._execute(job["msg"], build_reply=False)

@@ -1,7 +1,8 @@
 """Bench regression gate: compare a fresh `bench.py` run against the
 latest recorded round benchmark (BENCH_r*.json) and fail on a >10%
 regression in the e2e metrics (accepted throughput, client-perceived
-p50/p99, the lifecycle queue-wait/service totals) or the LSM store
+p50/p99, the lifecycle queue-wait/service totals, the commit-window
+occupancy commit_inflight_mean) or the LSM store
 metrics (config5 ingest / major-compaction rates), the recovery-time
 objectives (per-scenario recovery_time_s / degraded_throughput_pct from
 the chaos-at-load section — docs/CHAOS.md), or the front-door overload
@@ -67,6 +68,17 @@ GATED = (
     # its factors are already gated above.
     ("end_to_end", "queue_wait_total_p50_ms", False),
     ("end_to_end", "service_total_p50_ms", False),
+    # Cross-batch commit pipelining (depth-N dispatch window): mean
+    # in-flight batches through the commit stage, sampled once per
+    # processed batch (vsr/replica._stage_note_inflight → /lifecycle
+    # flat). Higher is better — a regression means the window stopped
+    # forming (dispatch refusals, a serialized seam, or the adaptive
+    # default silently collapsing to depth 1). Absent from pre-depth
+    # baselines: n/a, not failure; a crashed e2e section records no key
+    # → MISSING → fail-closed once a baseline carries it. commit_depth
+    # itself is recorded (not gated) so cross-host A/Bs can see which
+    # depth the adaptive default picked.
+    ("end_to_end", "commit_inflight_mean", True),
     # Store-stage hot row (device query-index pipeline, PR 8): mean
     # per-batch cost of the secondary-index key build + memtable insert
     # on the store thread, scraped from the registry's sm.store.query
