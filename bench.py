@@ -778,15 +778,19 @@ def bench_overload():
 
 def bench_recovery():
     """Recovery-time objectives under chaos at load (docs/CHAOS.md): the
-    four scenarios of testing/chaos.py, each ending in the byte-identical
-    determinism checks. kill_restart runs against a REAL `cli.py start`
-    process (SIGKILL + restart on the same FileStorage data file), with
-    its in-process twin's metrics + determinism verdict under
-    `kill_restart.sim`. Gated lower-better by tools/bench_gate.py
-    (recovery_time_s, degraded_throughput_pct per scenario). Lenient:
-    one scenario's failure must not kill the section, but its gated keys
-    go MISSING (not borrowed from the sim twin) so the gate fails them
-    against any baseline that recorded them."""
+    seven scenarios of testing/chaos.py — kill_restart / state_sync /
+    grid_storm / torn_checkpoint plus the primary-failover trio
+    (primary_kill, primary_flap, partition_primary; ISSUE 11) — each
+    ending in the byte-identical determinism checks. kill_restart runs
+    against a REAL `cli.py start` process (SIGKILL + restart on the same
+    FileStorage data file), with its in-process twin's metrics +
+    determinism verdict under `kill_restart.sim`. Gated lower-better by
+    tools/bench_gate.py (recovery_time_s, degraded_throughput_pct per
+    scenario; primary_kill gates view_change_time_s instead of its
+    recovery_time_s). Lenient: one scenario's failure must not kill the
+    section, but its gated keys go MISSING (not borrowed from the sim
+    twin) so the gate fails them against any baseline that recorded
+    them."""
     from tigerbeetle_tpu.testing import chaos
 
     t0 = time.perf_counter()

@@ -535,6 +535,83 @@ class TestChaosScenarios:
         assert "sim" in out["kill_restart"]
 
 
+# --- victim selection (the default crash target must be alive) -----------
+
+
+class TestVictimSelection:
+    def test_backup_of_view_skips_dead_replicas(self):
+        """`(primary + 1) % n` can point at a corpse after a prior crash:
+        the victim picker must return a LIVE non-primary, or a scenario
+        'crashes' a dead replica and measures nothing."""
+        h = ChaosHarness(seed=0xDEAD1)
+        h.drive_until(lambda: h.tip() >= 2, 60.0)
+        primary = h.primary_of_view()
+        first_backup = (primary + 1) % h.cluster.replica_count
+        assert h.backup_of_view() == first_backup  # fast path unchanged
+        h.cluster.crash_replica(first_backup, torn_write_probability=0.0)
+        victim = h.backup_of_view()
+        assert victim != primary
+        assert victim != first_backup
+        assert h.cluster.replicas[victim] is not None
+
+
+# --- primary failover scenarios (fast variants; bench runs full-size) -----
+
+
+class TestPrimaryFailover:
+    def _check_epilogue(self, res):
+        det = res.to_dict()["determinism"]
+        assert det["state_ops"] > 0
+        assert det["storage_checkpoint"] > 0
+        assert det["ops_checked"] > 0
+
+    def test_primary_kill(self):
+        res = chaos.scenario_primary_kill(base_s=0.4)
+        d = res.to_dict()
+        assert d["view_change_time_s"] > 0  # the gated election blackout
+        assert 0 <= d["degraded_throughput_pct"] <= 100
+        assert d["blackout_p99_ms"] >= 0
+        assert d["elected_view"] >= 1
+        # The new primary decomposed its own blackout into phases.
+        assert d["vc_svc_wait_s"] >= 0 and d["vc_sv_replay_s"] >= 0
+        self._check_epilogue(res)
+
+    def test_primary_flap(self):
+        res = chaos.scenario_primary_flap(cycles=2, base_s=0.4)
+        d = res.to_dict()
+        # Monotone view convergence across repeated elections is asserted
+        # INSIDE the scenario; here the telemetry must agree.
+        assert d["elections"] == 2
+        assert d["views_advanced"] >= 2
+        self._check_epilogue(res)
+
+    def test_partition_primary(self):
+        res = chaos.scenario_partition_primary(base_s=0.4)
+        d = res.to_dict()
+        assert d["view_change_time_s"] > 0
+        # The isolated primary piled up an uncommitted suffix and the
+        # epilogue's convergence checks prove it was truncated, not
+        # committed (the split-brain assertion).
+        assert d["isolated_suffix_ops"] >= 1
+        assert d["rejoin_view"] >= 1  # the old primary adopted the new view
+        self._check_epilogue(res)
+
+    def test_primary_kill_real_process(self):
+        """The ISSUE-11 bar, live: 3 × `cli.py start` over real TCP,
+        open-loop loadgen sessions, the process-level primary SIGKILLed
+        mid-load — clients fail over on their own, acked-before-kill
+        transfers durable on the new primary, failover timeline scraped
+        from /metrics."""
+        res = chaos.scenario_primary_kill_process(duration_s=10.0)
+        d = res.to_dict()
+        assert d["sessions_failed"] == 0
+        assert d["failover_count"] > 0
+        assert d["view_change_time_s"] > 0  # scraped via vsr.view gauges
+        assert d["acked_checked"] > 0  # durability across the election
+        assert d["blackout_p99_ms"] > 0
+        assert d["recovery_time_s"] > d["view_change_time_s"]
+
+
 # --- bench_gate: recovery-metric gating ----------------------------------
 
 
@@ -565,6 +642,10 @@ class TestBenchGateRecovery:
         },
         "torn_checkpoint": {
             "recovery_time_s": 0.5, "degraded_throughput_pct": 30.0,
+        },
+        "primary_kill": {
+            "recovery_time_s": 1.2, "view_change_time_s": 0.2,
+            "degraded_throughput_pct": 25.0,
         },
     }
 
@@ -628,4 +709,45 @@ class TestBenchGateRecovery:
         base["recovery"] = self.RECOVERY
         cur = json.loads(json.dumps(base))
         cur["recovery"]["kill_restart"]["recovery_time_s"] = 2.1  # +5%
+        assert self._gate(tmp_path, monkeypatch, base, cur) == 0
+
+    def test_primary_kill_view_change_regression_fails(
+        self, tmp_path, monkeypatch
+    ):
+        """The election blackout is gated with the established >10% rule."""
+        base = json.loads(json.dumps(self.BASE))
+        base["recovery"] = self.RECOVERY
+        cur = json.loads(json.dumps(base))
+        cur["recovery"]["primary_kill"]["view_change_time_s"] = 0.3  # +50%
+        assert self._gate(tmp_path, monkeypatch, base, cur) == 1
+
+    def test_primary_kill_missing_fails_closed(self, tmp_path, monkeypatch):
+        """A crashed primary_kill scenario records no gated keys —
+        MISSING must fail against a baseline that recorded them, exactly
+        like the round-12 recovery keys."""
+        base = json.loads(json.dumps(self.BASE))
+        base["recovery"] = self.RECOVERY
+        cur = json.loads(json.dumps(base))
+        cur["recovery"]["primary_kill"] = {"error": "TimeoutError: ..."}
+        assert self._gate(tmp_path, monkeypatch, base, cur) == 1
+
+    def test_primary_kill_na_against_prefailover_baseline(
+        self, tmp_path, monkeypatch
+    ):
+        base = json.loads(json.dumps(self.BASE))
+        base["recovery"] = {
+            k: v for k, v in self.RECOVERY.items() if k != "primary_kill"
+        }
+        cur = json.loads(json.dumps(self.BASE))
+        cur["recovery"] = self.RECOVERY
+        assert self._gate(tmp_path, monkeypatch, base, cur) == 0
+
+    def test_primary_kill_recovery_time_not_gated(self, tmp_path, monkeypatch):
+        """primary_kill.recovery_time_s (full redundancy-restored window)
+        is recorded, not gated — only the election blackout and the dip
+        carry the rule."""
+        base = json.loads(json.dumps(self.BASE))
+        base["recovery"] = self.RECOVERY
+        cur = json.loads(json.dumps(base))
+        cur["recovery"]["primary_kill"]["recovery_time_s"] = 10.0  # 8x
         assert self._gate(tmp_path, monkeypatch, base, cur) == 0

@@ -1,0 +1,346 @@
+"""Client failover across a view change (ISSUE 11 satellites).
+
+Three layers, cheapest first: scripted fake replicas drive the sync and
+async clients through hello → old-primary timeout → rotation → new-view
+reply (asserting the retry budget survives one election and BUSY backoff
+composes with rotation); an in-process 3-replica TCP cluster loses its
+real primary under loadgen sessions (a REAL election, not a script); the
+full real-process twin lives in tests/test_chaos.py.
+"""
+
+import asyncio
+import dataclasses
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.constants import TEST_MIN
+from tigerbeetle_tpu.vsr import header as hdr
+from tigerbeetle_tpu.vsr.header import Command, Message, Operation
+
+
+class _ScriptedReplica(threading.Thread):
+    """Scripted fake replica: answers hellos with `pong_view`, REGISTERs
+    with a reply, and data requests per script — swallow them (`silent`,
+    the crashed-primary model: the connection stays open, replies never
+    come), shed `busy_count` BUSYs first, then reply carrying
+    (reply_view, replica) as an elected primary would."""
+
+    def __init__(
+        self, *, replica=0, pong_view=0, reply_view=0,
+        silent=False, busy_count=0,
+    ):
+        super().__init__(daemon=True)
+        self.replica = replica
+        self.pong_view = pong_view
+        self.reply_view = reply_view
+        self.silent = silent
+        self.busy_count = busy_count
+        self.busy_sent = 0
+        self.data_requests = 0
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.port = self.sock.getsockname()[1]
+
+    @property
+    def address(self):
+        return ("127.0.0.1", self.port)
+
+    def run(self):
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    def stop(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def _serve(self, conn):
+        buf = b""
+
+        def read_msg():
+            nonlocal buf
+            while True:
+                if len(buf) >= hdr.HEADER_SIZE:
+                    h = hdr.Header.from_bytes(buf[: hdr.HEADER_SIZE])
+                    size = int(h["size"])
+                    if len(buf) >= size:
+                        buf = buf[size:]  # body content is irrelevant here
+                        return h
+                try:
+                    chunk = conn.recv(1 << 16)
+                except OSError:
+                    return None
+                if not chunk:
+                    return None
+                buf += chunk
+
+        with conn:
+            while True:
+                h = read_msg()
+                if h is None:
+                    return
+                cmd = int(h["command"])
+                client = int(h["client"])
+                if cmd == Command.PING_CLIENT:
+                    pong = hdr.make(
+                        Command.PONG_CLIENT, 0, client=client,
+                        replica=self.replica, view=self.pong_view,
+                    )
+                    conn.sendall(Message(pong).seal().to_bytes())
+                    continue
+                if cmd != Command.REQUEST:
+                    continue
+                request = int(h["request"])
+                op = int(h["operation"])
+                if op != Operation.REGISTER:
+                    self.data_requests += 1
+                    if self.silent:
+                        continue  # the crashed-primary model
+                    if self.busy_sent < self.busy_count:
+                        self.busy_sent += 1
+                        busy = hdr.make(
+                            Command.BUSY, 0, client=client, request=request,
+                        )
+                        conn.sendall(Message(busy).seal().to_bytes())
+                        continue
+                reply = hdr.make(
+                    Command.REPLY, 0, client=client, request=request,
+                    operation=op, replica=self.replica,
+                    view=self.reply_view if op != Operation.REGISTER else 0,
+                )
+                conn.sendall(Message(reply).seal().to_bytes())
+
+
+@pytest.fixture
+def election():
+    """Old primary A answers the register then goes silent; B answers
+    rotated requests as the view-1 primary. Both advertise view 0 in
+    pongs (pre-election belief) so the script's order is deterministic."""
+    a = _ScriptedReplica(replica=0, pong_view=0, silent=True)
+    b = _ScriptedReplica(replica=1, pong_view=0, reply_view=1)
+    a.start()
+    b.start()
+    yield a, b
+    a.stop()
+    b.stop()
+
+
+def test_sync_client_fails_over_within_budget(election, monkeypatch):
+    """hello → old-primary timeout → one rotation → new-view reply: the
+    budget (4*len+4 = 12 attempts) must survive an election on a couple
+    of rotations, and the reply's replica index re-aims the client."""
+    from tigerbeetle_tpu.client import Client
+
+    monkeypatch.setattr(Client, "REQUEST_TIMEOUT", 0.3)
+    a, b = election
+    client = Client([a.address, b.address])
+    out = client.lookup_accounts([1])
+    assert len(out) == 0  # scripted empty reply body
+    assert a.data_requests >= 1  # the old primary swallowed the request
+    assert client.rotations == 1, (
+        f"one view change must cost one rotation, not {client.rotations}"
+    )
+    assert client.rotations < 4 * len(client.addresses) + 4
+    assert client._target == 1  # re-aimed at the elected primary
+    client.close()
+
+
+def test_sync_client_busy_composes_with_rotation(monkeypatch):
+    """After rotating to the new primary, a BUSY shed there backs off and
+    resends WITHOUT consuming another rotation — admission control and
+    failover compose instead of multiplying."""
+    from tigerbeetle_tpu.client import Client
+
+    monkeypatch.setattr(Client, "REQUEST_TIMEOUT", 0.3)
+    a = _ScriptedReplica(replica=0, pong_view=0, silent=True)
+    b = _ScriptedReplica(replica=1, pong_view=0, reply_view=1, busy_count=2)
+    a.start()
+    b.start()
+    try:
+        client = Client([a.address, b.address])
+        out = client.lookup_accounts([1])
+        assert len(out) == 0
+        assert b.busy_sent == 2
+        assert client.busy_count == 2
+        assert client.rotations == 1  # BUSY retries consumed none
+        client.close()
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_async_client_fails_over_within_budget(election, monkeypatch):
+    from tigerbeetle_tpu.client import AsyncClient
+
+    monkeypatch.setattr(AsyncClient, "REQUEST_TIMEOUT", 0.3)
+    a, b = election
+
+    async def go():
+        ac = AsyncClient([a.address, b.address], sessions=1)
+        await ac.start()
+        ids = np.zeros(1, dtype=types.ID_DTYPE)
+        reply = await ac.submit(Operation.LOOKUP_ACCOUNTS, ids)
+        await ac.close()
+        return reply, ac.rotations, ac._target
+
+    reply, rotations, target = asyncio.run(go())
+    assert int(reply.header["view"]) == 1
+    assert rotations == 1, f"one view change cost {rotations} rotations"
+    assert rotations < 4 * 2 + 4
+    assert target == 1  # REPLY's replica index re-aimed the pool
+
+
+def test_async_client_busy_composes_with_rotation(monkeypatch):
+    from tigerbeetle_tpu.client import AsyncClient
+
+    monkeypatch.setattr(AsyncClient, "REQUEST_TIMEOUT", 0.3)
+    a = _ScriptedReplica(replica=0, pong_view=0, silent=True)
+    b = _ScriptedReplica(replica=1, pong_view=0, reply_view=1, busy_count=1)
+    a.start()
+    b.start()
+
+    async def go():
+        ac = AsyncClient([a.address, b.address], sessions=1)
+        await ac.start()
+        ids = np.zeros(1, dtype=types.ID_DTYPE)
+        await ac.submit(Operation.LOOKUP_ACCOUNTS, ids)
+        await ac.close()
+        return ac.busy_count, ac.rotations
+
+    try:
+        busy, rotations = asyncio.run(go())
+        assert busy == 1
+        assert rotations == 1
+    finally:
+        a.stop()
+        b.stop()
+
+
+# --- a REAL election under loadgen sessions (in-process TCP cluster) ------
+
+
+class _TcpCluster:
+    """Three ReplicaServers over real TCP in one background asyncio loop
+    (the MultiServerThread shape from test_integration, plus per-server
+    stop so a test can kill the live primary)."""
+
+    def __init__(self, tmp, clients_max=64):
+        from tigerbeetle_tpu.io.storage import FileStorage, Zone
+        from tigerbeetle_tpu.net.bus import ReplicaServer
+        from tigerbeetle_tpu.vsr.replica import Replica
+
+        config = dataclasses.replace(TEST_MIN, clients_max=clients_max)
+        zone = Zone.for_config(
+            config.journal_slot_count, config.message_size_max,
+            grid_block_count=config.grid_block_count,
+            grid_block_size=config.lsm_block_size,
+        )
+        ports = []
+        for _ in range(3):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+            s.close()
+        self.addresses = [("127.0.0.1", p) for p in ports]
+        self.servers = []
+        self.storages = []
+        for i in range(3):
+            st = FileStorage(
+                str(tmp / f"r{i}.tb"), size=zone.total_size, create=True
+            )
+            Replica.format(st, zone, 0, i, 3)
+            replica = Replica(
+                cluster=0, replica_index=i, replica_count=3,
+                storage=st, zone=zone, config=config,
+                bus=None, sm_backend="numpy",
+            )
+            self.servers.append(ReplicaServer(replica, self.addresses))
+            self.storages.append(st)
+            replica.open()
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+        time.sleep(0.3)
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+
+        async def run_all():
+            for s in self.servers:
+                await s.start()
+            await asyncio.gather(*[s._stopping.wait() for s in self.servers])
+
+        self.loop.run_until_complete(run_all())
+
+    def wait_primary(self, timeout=30.0, min_view=0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            for i, s in enumerate(self.servers):
+                if s.replica.is_primary and s.replica.view > min_view:
+                    return i
+            time.sleep(0.05)
+        raise TimeoutError("no primary elected")
+
+    def stop_server(self, i):
+        self.loop.call_soon_threadsafe(self.servers[i].stop)
+
+    def stop(self):
+        for s in self.servers:
+            self.loop.call_soon_threadsafe(s.stop)
+        self.thread.join(timeout=5)
+        for st in self.storages:
+            st.close()
+
+
+def test_loadgen_sessions_survive_real_election(tmp_path):
+    """Kill the LIVE primary of an in-process 3-replica TCP cluster under
+    open-loop loadgen sessions: the survivors elect, the multi-address
+    sessions fail over on their own (failover_count > 0), nothing is
+    lost (sessions_failed == 0), and throughput resumes in the new view."""
+    from tigerbeetle_tpu.testing import loadgen
+
+    cluster = _TcpCluster(tmp_path)
+    try:
+        primary = cluster.wait_primary()
+        loadgen.create_accounts(cluster.addresses, 64)
+
+        lg = loadgen.LoadGen(
+            cluster.addresses, sessions=6, accounts=64, batch=32,
+            offered_rate=600.0, duration_s=7.0, ramp_s=0.5, seed=0xE1EC,
+            request_timeout=1.0,
+        )
+
+        async def drive():
+            task = asyncio.ensure_future(lg.run())
+            while lg.stats.accepted_tx == 0:
+                await asyncio.sleep(0.05)
+            accepted_pre = lg.stats.accepted_tx
+            cluster.stop_server(primary)  # the election fires mid-load
+            return accepted_pre, await task
+
+        accepted_pre, res = asyncio.run(drive())
+        new_primary = cluster.wait_primary(
+            min_view=cluster.servers[primary].replica.view
+        )
+        assert new_primary != primary
+        assert res["sessions_failed"] == 0, res
+        assert res["failover_count"] > 0, res
+        assert res["accepted_tx"] > accepted_pre, (
+            "no throughput after the election"
+        )
+        assert res["blackouts"] > 0 and res["blackout_p99_ms"] > 0, res
+    finally:
+        cluster.stop()

@@ -1,0 +1,262 @@
+"""Wire-level fault injection on the real TCP bus (ISSUE 11 tentpole 3).
+
+The shim (net/bus.NetFault, TIGERBEETLE_TPU_NET_FAULT) must be provably
+inert when disabled, and when armed its corrupt frames must be REJECTED
+by the existing header checksum on a live peer connection — counted,
+connection recovered, no replica crash.
+"""
+
+import asyncio
+import dataclasses
+import socket
+import threading
+import time
+
+import pytest
+
+from tigerbeetle_tpu import tracer, types
+from tigerbeetle_tpu.net.bus import HEADER_SIZE, NetFault, read_message
+from tigerbeetle_tpu.vsr import header as hdr
+from tigerbeetle_tpu.vsr.header import Command, Header, Message
+
+
+class TestNetFaultSpec:
+    def test_parse_full_spec(self):
+        nf = NetFault(
+            "drop=0.02,dup=0.01,corrupt=0.005,delay_ms=2,blackhole=1|2,seed=7"
+        )
+        assert nf.drop == 0.02
+        assert nf.dup == 0.01
+        assert nf.corrupt == 0.005
+        assert nf.delay_s == 0.002
+        assert nf.blackhole == frozenset((1, 2))
+
+    def test_unknown_key_raises(self):
+        # A typo'd fault key silently injecting nothing would let a chaos
+        # run pass without its faults — fail loudly instead.
+        with pytest.raises(ValueError):
+            NetFault("dorp=0.5")
+
+    def test_from_env_unset_is_none(self, monkeypatch):
+        monkeypatch.delenv("TIGERBEETLE_TPU_NET_FAULT", raising=False)
+        assert NetFault.from_env() is None
+        monkeypatch.setenv("TIGERBEETLE_TPU_NET_FAULT", "")
+        assert NetFault.from_env() is None
+        monkeypatch.setenv("TIGERBEETLE_TPU_NET_FAULT", "drop=0.1")
+        nf = NetFault.from_env()
+        assert nf is not None and nf.drop == 0.1
+
+
+class _FakeConn:
+    def __init__(self):
+        self.raw = []  # send(bytes) — the fault path
+        self.msgs = []  # send_message(Message) — the clean path
+
+    def send(self, data, command=None):
+        self.raw.append(bytes(data))
+        self.commands = getattr(self, "commands", [])
+        self.commands.append(command)
+
+    def send_message(self, msg):
+        self.msgs.append(msg)
+
+
+class _StubReplica:
+    replica = 0
+    cluster = 0
+
+
+def _server(net_fault=None):
+    from tigerbeetle_tpu.net.bus import ReplicaServer
+
+    srv = ReplicaServer(_StubReplica(), [("127.0.0.1", 1)])
+    if net_fault is not None:
+        srv.net_fault = net_fault
+    return srv
+
+
+def _ping(replica=0):
+    return Message(
+        hdr.make(Command.PING, 0, replica=replica, view=0)
+    ).seal()
+
+
+class TestSendPath:
+    def test_disabled_shim_is_clean_path(self, monkeypatch):
+        """Unset env → net_fault is None → sends take the unmodified
+        send_message path (the provably-no-op acceptance bar)."""
+        monkeypatch.delenv("TIGERBEETLE_TPU_NET_FAULT", raising=False)
+        srv = _server()
+        assert srv.net_fault is None
+        conn = _FakeConn()
+        srv.peer_conns[1] = conn
+        srv.send_to_replica(1, _ping())
+        assert len(conn.msgs) == 1 and not conn.raw
+
+    def test_blackhole_drops_outbound(self):
+        srv = _server(NetFault("blackhole=2"))
+        c1, c2 = _FakeConn(), _FakeConn()
+        srv.peer_conns[1] = c1
+        srv.peer_conns[2] = c2
+        tracer.enable()
+        tracer.reset()
+        try:
+            srv.send_to_replica(2, _ping())
+            srv.send_to_replica(1, _ping())
+            assert not c2.msgs and not c2.raw  # isolated
+            assert len(c1.msgs) == 1  # untargeted peer unaffected
+            snap = tracer.snapshot()
+            assert snap["bus.fault.blackholed"]["count"] == 1
+        finally:
+            tracer.disable()
+
+    def test_drop_all_counts(self):
+        srv = _server(NetFault("drop=1.0,seed=1"))
+        conn = _FakeConn()
+        srv.peer_conns[1] = conn
+        tracer.enable()
+        tracer.reset()
+        try:
+            for _ in range(4):
+                srv.send_to_replica(1, _ping())
+            assert not conn.msgs and not conn.raw
+            assert tracer.snapshot()["bus.fault.dropped"]["count"] == 4
+        finally:
+            tracer.disable()
+
+    def test_corrupt_frame_fails_header_checksum(self):
+        """The corrupted frame must be rejected by the header MAC before
+        any field (size included) is trusted."""
+        srv = _server(NetFault("corrupt=1.0,seed=3"))
+        conn = _FakeConn()
+        srv.peer_conns[1] = conn
+        srv.send_to_replica(1, _ping())
+        assert len(conn.raw) == 1
+        h = Header.from_bytes(conn.raw[0][:HEADER_SIZE])
+        assert not h.valid_checksum()
+        # The faulted path must keep the frame's backpressure class: a
+        # pre-serialized control frame rides the control budget.
+        assert conn.commands == [Command.PING]
+
+    def test_duplicate_sends_twice(self):
+        srv = _server(NetFault("dup=1.0,seed=5"))
+        conn = _FakeConn()
+        srv.peer_conns[1] = conn
+        srv.send_to_replica(1, _ping())
+        assert len(conn.msgs) + len(conn.raw) == 2
+
+
+def test_read_message_counts_checksum_fail():
+    """A flipped wire byte is rejected (None) and counted — the counter
+    is the real bus's only evidence that corruption ever arrived."""
+    frame = bytearray(_ping().to_bytes())
+    frame[7] ^= 0xA5
+
+    async def go():
+        reader = asyncio.StreamReader()
+        reader.feed_data(bytes(frame))
+        reader.feed_eof()
+        return await read_message(reader)
+
+    tracer.enable()
+    tracer.reset()
+    try:
+        assert asyncio.run(go()) is None
+        assert tracer.snapshot()["bus.rx_checksum_fail"]["count"] == 1
+    finally:
+        tracer.disable()
+
+
+# --- corruption on a LIVE peer connection ---------------------------------
+
+
+def test_corrupt_peer_frames_rejected_cluster_survives(tmp_path):
+    """Arm corrupt=0.5 on one replica's outbound peer frames in a real
+    3-replica TCP cluster: corrupted frames are rejected by checksum
+    (bus.rx_checksum_fail counts), the peer connections recover by
+    reconnecting, no replica crashes, and client commits keep flowing
+    through the surviving quorum."""
+    from tigerbeetle_tpu.client import Client
+    from tigerbeetle_tpu.io.storage import FileStorage, Zone
+    from tigerbeetle_tpu.net.bus import ReplicaServer
+    from tigerbeetle_tpu.vsr.replica import Replica
+    from tigerbeetle_tpu.constants import TEST_MIN
+
+    config = dataclasses.replace(TEST_MIN, clients_max=16)
+    zone = Zone.for_config(
+        config.journal_slot_count, config.message_size_max,
+        grid_block_count=config.grid_block_count,
+        grid_block_size=config.lsm_block_size,
+    )
+    ports = []
+    for _ in range(3):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+    addresses = [("127.0.0.1", p) for p in ports]
+    servers, storages = [], []
+    for i in range(3):
+        st = FileStorage(
+            str(tmp_path / f"r{i}.tb"), size=zone.total_size, create=True
+        )
+        Replica.format(st, zone, 0, i, 3)
+        replica = Replica(
+            cluster=0, replica_index=i, replica_count=3,
+            storage=st, zone=zone, config=config,
+            bus=None, sm_backend="numpy",
+        )
+        servers.append(ReplicaServer(replica, addresses))
+        storages.append(st)
+        replica.open()
+    # Replica 1's outbound peer frames flip bytes half the time.
+    servers[1].net_fault = NetFault("corrupt=0.5,seed=11")
+
+    loop = asyncio.new_event_loop()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def run_all():
+            for s in servers:
+                await s.start()
+            await asyncio.gather(*[s._stopping.wait() for s in servers])
+
+        loop.run_until_complete(run_all())
+
+    thread = threading.Thread(target=run, daemon=True)
+    thread.start()
+    tracer.enable()
+    tracer.reset()
+    try:
+        time.sleep(0.3)
+        client = Client(addresses)
+        ev = types.batch(
+            [types.account(id=i, ledger=1, code=10) for i in (1, 2)],
+            types.ACCOUNT_DTYPE,
+        )
+        assert len(client.create_accounts(ev)) == 0
+        for t in range(1, 9):
+            tr = types.batch(
+                [types.transfer(id=t, debit_account_id=1,
+                                credit_account_id=2, amount=1,
+                                ledger=1, code=1)],
+                types.TRANSFER_DTYPE,
+            )
+            assert len(client.create_transfers(tr)) == 0
+        out = client.lookup_accounts([1])
+        assert types.u128_of(out[0], "debits_posted") == 8
+        client.close()
+        snap = tracer.snapshot()
+        # The shim injected, the receivers rejected by checksum, and no
+        # replica failed stop (commits above prove the quorum lived).
+        assert snap.get("bus.fault.corrupted", {}).get("count", 0) > 0
+        assert snap.get("bus.rx_checksum_fail", {}).get("count", 0) > 0
+        assert all(not s._stopping.is_set() for s in servers)
+    finally:
+        tracer.disable()
+        for s in servers:
+            loop.call_soon_threadsafe(s.stop)
+        thread.join(timeout=5)
+        for st in storages:
+            st.close()

@@ -72,6 +72,10 @@ class Client:
         self._target = 0
         self.registered = False
         self.busy_count = 0  # BUSY sheds absorbed (admission-control telemetry)
+        # Target rotations consumed (failover telemetry): one view change
+        # must cost a handful of these, never the whole retry budget
+        # (4 * len(addresses) + 4 attempts per request).
+        self.rotations = 0
         self.register()
 
     # --- wire -----------------------------------------------------------
@@ -164,6 +168,7 @@ class Client:
             s = self._socks.get(target) or self._connect(target)
             if s is None:
                 self._target += 1
+                self.rotations += 1
                 attempt += 1
                 continue
             try:
@@ -171,6 +176,7 @@ class Client:
             except OSError:
                 self._socks.pop(target, None)
                 self._target += 1
+                self.rotations += 1
                 attempt += 1
                 continue
             deadline = time.monotonic() + self.REQUEST_TIMEOUT
@@ -229,6 +235,7 @@ class Client:
                 time.sleep(busy_backoff_s(busy_retries))
                 continue
             self._target += 1
+            self.rotations += 1
             attempt += 1
         raise ClientError("request timed out against every replica")
 
@@ -434,6 +441,10 @@ class AsyncClient:
         self.perceived: List[float] = []
         # BUSY sheds absorbed across all sessions (admission telemetry).
         self.busy_count = 0
+        # Target rotations consumed across all sessions (failover
+        # telemetry): one view change must cost a handful, never the
+        # per-request budget of 4 * len(addresses) + 4.
+        self.rotations = 0
 
     async def __aenter__(self) -> "AsyncClient":
         await self.start()
@@ -566,12 +577,14 @@ class AsyncClient:
                 if not await self._send(self._target % len(self.addresses), msg, body):
                     self._target += 1
                     rotations += 1
+                    self.rotations += 1
                     continue
                 try:
                     reply = await asyncio.wait_for(fut, self.REQUEST_TIMEOUT)
                 except asyncio.TimeoutError:
                     self._target += 1  # rotate replicas and resend
                     rotations += 1
+                    self.rotations += 1
                     continue
                 if reply.header["command"] == Command.BUSY:
                     # Admission shed: back off, resend the SAME request to

@@ -105,8 +105,12 @@ class _Conn:
             return False
         return True
 
-    def send(self, data: bytes) -> None:
-        if self._can_send(len(data)):
+    def send(self, data: bytes, command: Optional[int] = None) -> None:
+        """`command` selects the control-plane backpressure budget, same
+        as send_message — pre-serialized senders (the net-fault shim)
+        must not silently demote view-protocol frames to the bulk
+        budget."""
+        if self._can_send(len(data), command):
             self.writer.write(data)
             tracer.count("bus.tx_messages")
             tracer.count("bus.tx_bytes", len(data))
@@ -136,6 +140,12 @@ async def read_message(reader: asyncio.StreamReader) -> Optional[Message]:
         return None
     h = Header.from_bytes(hraw)
     if not h.valid_checksum():
+        # A flipped wire byte lands HERE: the header MAC rejects the
+        # frame before any field (size included) is trusted, the counter
+        # records it, and returning None drops the connection — framing
+        # can never resync past corrupt bytes, so reconnect-clean is the
+        # recovery (every VSR message is retried/re-derived).
+        tracer.count("bus.rx_checksum_fail")
         # Distinguish a misconfigured cluster from corruption: replicas
         # formatted/running under a different TIGERBEETLE_TPU_CHECKSUM
         # would otherwise fail every MAC silently and never form quorum.
@@ -166,7 +176,83 @@ async def read_message(reader: asyncio.StreamReader) -> Optional[Message]:
     if ok:
         tracer.count("bus.rx_messages")
         tracer.count("bus.rx_bytes", size)
+    else:
+        tracer.count("bus.rx_checksum_fail")
     return msg if ok else None
+
+
+class NetFault:
+    """Wire-level fault injection on PEER frames (docs/CHAOS.md).
+
+    The FileStorage twin of round-12's storage fault parity: the real TCP
+    bus gets the same fault classes the packet simulator has always had —
+    drop, delay, duplicate, corrupt, and a per-peer blackhole (what makes
+    `partition_primary` runnable on real processes without iptables).
+    Client connections are untouched: the faults model a flaky REPLICA
+    link, and the recovery path for everything (view protocol, repair) is
+    peer traffic, which is exactly what must be exercised.
+
+    Enabled by `TIGERBEETLE_TPU_NET_FAULT`, a comma-separated spec:
+
+        drop=0.02       P(drop) per outbound peer frame
+        dup=0.01        P(send twice)
+        corrupt=0.005   P(flip one header byte) — the receiving bus MUST
+                        reject the frame by header checksum
+                        (`bus.rx_checksum_fail`) and reconnect clean
+        delay_ms=2      jittered per-frame delay (0.5x-1.5x)
+        blackhole=1|2   peer replica indexes to isolate, both directions
+        seed=7          fault RNG seed (deterministic schedules)
+
+    Unset/empty: `ReplicaServer.net_fault` is None and the hot send path
+    pays exactly one `is not None` check — provably a no-op (the
+    determinism suites never construct a ReplicaServer, and servers built
+    without the env are byte-identical to pre-shim behavior)."""
+
+    __slots__ = ("drop", "dup", "corrupt", "delay_s", "blackhole", "rng")
+
+    def __init__(self, spec: str, seed: int = 0) -> None:
+        import random as _random
+
+        self.drop = 0.0
+        self.dup = 0.0
+        self.corrupt = 0.0
+        self.delay_s = 0.0
+        self.blackhole: frozenset = frozenset()
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            k, _, v = part.partition("=")
+            if k == "drop":
+                self.drop = float(v)
+            elif k == "dup":
+                self.dup = float(v)
+            elif k == "corrupt":
+                self.corrupt = float(v)
+            elif k == "delay_ms":
+                self.delay_s = float(v) / 1e3
+            elif k == "blackhole":
+                self.blackhole = frozenset(
+                    int(x) for x in v.split("|") if x != ""
+                )
+            elif k == "seed":
+                seed = int(v)
+            else:
+                # A typo'd fault key silently injecting nothing would be
+                # a dangerous way to believe a chaos run passed.
+                raise ValueError(
+                    f"TIGERBEETLE_TPU_NET_FAULT: unknown key {k!r} in "
+                    f"{spec!r} (known: drop dup corrupt delay_ms "
+                    "blackhole seed)"
+                )
+        self.rng = _random.Random(seed)
+
+    @staticmethod
+    def from_env() -> Optional["NetFault"]:
+        import os
+
+        spec = os.environ.get("TIGERBEETLE_TPU_NET_FAULT", "")
+        return NetFault(spec) if spec.strip() else None
 
 
 class ReplicaServer:
@@ -210,6 +296,10 @@ class ReplicaServer:
         # saturated, so a firehose sender backs up into TCP instead of
         # our heap.
         self._rx_stalled = 0  # tidy: owner=loop
+        # Wire-level fault injection on peer frames (TIGERBEETLE_TPU_NET_FAULT,
+        # docs/CHAOS.md). None when the env is unset: the peer send path
+        # pays one `is not None` check and nothing else.
+        self.net_fault: Optional[NetFault] = NetFault.from_env()
         replica.bus = self  # inject ourselves as the bus
 
     @property
@@ -224,7 +314,53 @@ class ReplicaServer:
             return
         conn = self.peer_conns.get(r)
         if conn is not None:
+            if self.net_fault is not None:
+                self._send_faulted(r, conn, msg)
+                return
             conn.send_message(msg)
+
+    def _send_faulted(self, r: int, conn: _Conn, msg: Message) -> None:
+        """Peer send through the fault shim (never on the clean path):
+        blackhole → drop → dup → corrupt → delay, with a bus.fault.*
+        counter per injection so a chaos run can prove its faults fired."""
+        nf = self.net_fault
+        if r in nf.blackhole:
+            tracer.count("bus.fault.blackholed")
+            return
+        if nf.drop and nf.rng.random() < nf.drop:
+            tracer.count("bus.fault.dropped")
+            return
+        copies = 2 if (nf.dup and nf.rng.random() < nf.dup) else 1
+        if copies == 2:
+            tracer.count("bus.fault.duplicated")
+        command = int(msg.header["command"])
+        for _ in range(copies):
+            payload: Optional[bytes] = None
+            if nf.corrupt and nf.rng.random() < nf.corrupt:
+                # Flip one header byte: the receiver's header MAC covers
+                # every field, so the frame is rejected before `size` is
+                # trusted — the failure mode is a counted checksum drop
+                # plus reconnect, never a desynced stream parse.
+                data = bytearray(msg.to_bytes())
+                data[nf.rng.randrange(HEADER_SIZE)] ^= 0xA5
+                payload = bytes(data)
+                tracer.count("bus.fault.corrupted")
+            if nf.delay_s:
+                data = payload if payload is not None else msg.to_bytes()
+                tracer.count("bus.fault.delayed")
+                try:
+                    loop = asyncio.get_running_loop()
+                except RuntimeError:
+                    conn.send(data, command)  # no loop (unit harness)
+                else:
+                    loop.call_later(
+                        nf.delay_s * (0.5 + nf.rng.random()),
+                        conn.send, data, command,
+                    )
+            elif payload is not None:
+                conn.send(payload, command)
+            else:
+                conn.send_message(msg)
 
     def _dispatch(self, msg: Message) -> None:
         """Fail-stop on replica exceptions (the reference's assert-and-crash
@@ -432,6 +568,18 @@ class ReplicaServer:
                         tracer.gauge("bus.client_conns", len(self.client_conns))
             elif h["replica"] != self.me_index:
                 r = h["replica"]
+                if (
+                    self.net_fault is not None
+                    and r in self.net_fault.blackhole
+                    and cmd == Command.PING
+                ):
+                    # Inbound side of the per-peer blackhole: even the
+                    # identifying PING is dropped (below, identified
+                    # traffic is dropped wholesale) — one side's env
+                    # isolates the pair in BOTH directions, which is what
+                    # a partition needs.
+                    tracer.count("bus.fault.blackholed")
+                    continue
                 if cmd == Command.PING:
                     # Latest-wins remap on PINGs ONLY: pings always carry
                     # the SENDER's identity, so a promoted standby's pings
@@ -444,6 +592,13 @@ class ReplicaServer:
                 elif peer_replica is None:
                     peer_replica = r
                     self.peer_conns.setdefault(r, conn)
+            if (
+                self.net_fault is not None
+                and peer_replica is not None
+                and peer_replica in self.net_fault.blackhole
+            ):
+                tracer.count("bus.fault.blackholed")
+                continue
             self._dispatch(msg)
             if (
                 cmd == Command.REQUEST and h["client"] != 0
@@ -484,4 +639,10 @@ class ReplicaServer:
             msg = await read_message(reader)
             if msg is None:
                 return
+            if (
+                self.net_fault is not None
+                and expected_replica in self.net_fault.blackhole
+            ):
+                tracer.count("bus.fault.blackholed")
+                continue
             self._dispatch(msg)

@@ -113,6 +113,17 @@ class Simulator:
             rng.randint(3, max(3, requests // 2))
             if self.replica_count >= 2 and rng.random() < 0.5 else None
         )
+        # Primary-targeted crash schedule (ISSUE 11: every taxonomy above
+        # picks victims by fixed index, which after round 1 is almost
+        # always a backup): the victim is whoever is PRIMARY at the
+        # scheduled tick, resolved at runtime — by then earlier faults
+        # may have moved the view. Drawn AFTER every existing schedule so
+        # historical seeds (the pinned smoke set included) keep their
+        # schedules byte-for-byte.
+        self.crash_primary_at: dict[int, int] = {}  # tick -> restart tick
+        if self.replica_count >= 3 and rng.random() < 0.35:
+            t = rng.randint(150, 700)
+            self.crash_primary_at[t] = t + rng.randint(400, 1500)
         self.log = []
 
     def run(self, tick_budget: int = 200_000) -> int:
@@ -121,6 +132,7 @@ class Simulator:
             c.register()
         down: set[int] = set()
         self.promote_pending: tuple | None = None
+        primary_restart_at: dict[int, int] = {}  # resolved at crash time
         tick = 0
         last_progress = 0
         last_done = 0
@@ -142,6 +154,42 @@ class Simulator:
                     torn = self.rng.choice([0.0, 0.3, 0.7])
                     cl.crash_replica(victim, torn_write_probability=torn)
                     self.log.append((tick, f"crash replica {victim} torn={torn}"))
+            if tick in self.crash_primary_at:
+                live_ix = [
+                    i for i in range(self.replica_count)
+                    if i not in down and cl.replicas[i] is not None
+                ]
+                if live_ix:
+                    view = max(cl.replicas[i].view for i in live_ix)
+                    victim = view % self.replica_count
+                    live = self.replica_count - len(down)
+                    if (
+                        victim not in down
+                        and cl.replicas[victim] is not None
+                        and live - 1 > self.replica_count // 2
+                    ):
+                        down.add(victim)
+                        torn = self.rng.choice([0.0, 0.3, 0.7])
+                        cl.crash_replica(victim, torn_write_probability=torn)
+                        rt = self.crash_primary_at[tick]
+                        while rt in primary_restart_at or rt in self.restart_at:
+                            rt += 1  # never clobber another restart
+                        primary_restart_at[rt] = victim
+                        from tigerbeetle_tpu import tracer
+
+                        # Sweep coverage mark: schedules CARRY primary
+                        # crashes often, but the quorum guard fires them
+                        # rarely — the sweep asserts they actually run.
+                        tracer.count("mark.primary_crash")
+                        self.log.append(
+                            (tick, f"crash primary {victim} torn={torn}")
+                        )
+            if tick in primary_restart_at:
+                victim = primary_restart_at[tick]
+                if victim in down:
+                    down.discard(victim)
+                    cl.restart_replica(victim)
+                    self.log.append((tick, f"restart ex-primary {victim}"))
             if tick in self.restart_at:
                 victim = self.restart_at[tick]
                 if victim in down:
@@ -289,10 +337,14 @@ def run_seed(seed: int, requests: int, verbose: bool) -> int:
 # Fixed smoke seed set (--smoke): a tier-1-sized slice of the VOPR so the
 # chaos paths cannot bit-rot between full sweeps. Chosen (and ASSERTED
 # below, so a schedule-taxonomy edit that tames them fails loudly) to
-# cover: a crash/restart schedule (seed 0), a grid-corruption schedule
-# (seed 1), the single-replica fail-stop path (seed 2), and a combined
-# crash+corruption 3-replica schedule (seed 9).
-SMOKE_SEEDS = (0, 1, 2, 9)
+# cover: a crash/restart schedule plus a primary-crash + partition
+# schedule (seed 0), a grid-corruption schedule (seed 1), the
+# single-replica fail-stop path (seed 2), a PRIMARY-targeted crash that
+# actually FIRES mid-run next to a firing partition on a 5-replica
+# cluster (seed 5 — the quorum guard suppresses the primary crash when a
+# prior fault already holds a member down, so most schedules only carry
+# it), and a combined crash+corruption 3-replica schedule (seed 9).
+SMOKE_SEEDS = (0, 1, 2, 5, 9)
 SMOKE_REQUESTS = 12
 SMOKE_BUDGET_S = 120.0
 
@@ -302,15 +354,22 @@ def run_smoke(budget_s: float = SMOKE_BUDGET_S, verbose: bool = False) -> int:
     import time
 
     crash_covered = corrupt_covered = False
+    primary_covered = partition_covered = False
     for seed in SMOKE_SEEDS:
         sim = Simulator(seed, requests=SMOKE_REQUESTS)
         crash_covered |= bool(sim.crash_at)
         corrupt_covered |= sim.corrupt_grid_after is not None
-    if not (crash_covered and corrupt_covered):
+        primary_covered |= bool(sim.crash_primary_at)
+        partition_covered |= bool(sim.partition_at)
+    if not (
+        crash_covered and corrupt_covered
+        and primary_covered and partition_covered
+    ):
         print(
             f"smoke: seed set {SMOKE_SEEDS} no longer covers "
-            f"crash={crash_covered} corruption={corrupt_covered} — the "
-            "schedule taxonomy changed; repick SMOKE_SEEDS",
+            f"crash={crash_covered} corruption={corrupt_covered} "
+            f"primary_crash={primary_covered} partition={partition_covered} "
+            "— the schedule taxonomy changed; repick SMOKE_SEEDS",
             file=sys.stderr,
         )
         return EXIT_LIVENESS
@@ -376,7 +435,7 @@ def main(argv=None) -> int:
                 required
                 for required in (
                     "mark.view_change_enter", "mark.wal_repair_request",
-                    "mark.journal_slot_faulty",
+                    "mark.journal_slot_faulty", "mark.primary_crash",
                 )
                 if not marks.get(required)
             ]

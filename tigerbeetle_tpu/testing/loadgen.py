@@ -17,8 +17,12 @@ Pieces:
               is connection scale, not socket multiplexing): register,
               one request in flight, BUSY backoff, EVICTION →
               re-register → resend, reconnect-with-retry on connection
-              loss. A slow-reader session delays its reads to exercise
-              the server's send-queue backpressure.
+              loss. Multi-replica address lists add primary failover:
+              connects rotate across replicas, the hello's PONG_CLIENT
+              steers to `view % n` (only the primary's connection can
+              carry replies), and the run records `failover_count` plus
+              per-session blackout windows. A slow-reader session delays
+              its reads to exercise the server's send-queue backpressure.
   LoadGen     N sessions + Poisson arrival generator (Zipf account skew)
               + churn schedule: ramp-in, abrupt disconnect storms
               (transport.abort — no FIN), identity rotation (fresh
@@ -52,6 +56,15 @@ from tigerbeetle_tpu.vsr import header as hdr
 from tigerbeetle_tpu.vsr.header import Command, Message, Operation
 
 Address = Tuple[str, int]
+
+
+def percentile(sorted_vals: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile over pre-sorted values (0.0 when empty) —
+    the one shared copy of the idiom (LoadGen results, chaos blackout
+    windows)."""
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(len(sorted_vals) - 1, int(len(sorted_vals) * q))]
 
 
 def zipf_cdf(n_accounts: int, s: float) -> Optional[np.ndarray]:
@@ -128,7 +141,15 @@ class _Stats:
     reconnects: int = 0
     timeouts: int = 0
     dropped: int = 0  # arrivals abandoned (retry budget exhausted)
+    # Times a session's established connection moved to a DIFFERENT
+    # replica address than its previous one (primary failover telemetry;
+    # plain reconnects to the same address are `reconnects`).
+    failovers: int = 0
     perceived: List[float] = field(default_factory=list)
+    # Client-perceived blackout windows, seconds: first failed attempt of
+    # a roundtrip → its next successful reply. During a primary failover
+    # this is exactly the per-session outage the election cost.
+    blackouts: List[float] = field(default_factory=list)
     # Sample of acked transfer ids for the post-run durability audit.
     acked_sample: List[int] = field(default_factory=list)
 
@@ -158,15 +179,22 @@ class _Session:
         self.slow_s = 0.0  # per-read delay: the slow-reader client model
         self.registered = False
         self.alive = True
+        # Multi-replica failover state: which address we try next, and
+        # which one the last ESTABLISHED connection used (a reconnect
+        # landing elsewhere counts as a failover).
+        self.addr_ix = 0
+        self._established_ix: Optional[int] = None
 
     # --- wire ----------------------------------------------------------
 
     async def _connect(self) -> None:
         backoff = 0.05
         last: Optional[Exception] = None
+        n = len(self.addresses)
         for _ in range(self.CONNECT_RETRIES):
+            ix = self.addr_ix % n
             try:
-                host, port = self.addresses[0]
+                host, port = self.addresses[ix]
                 self.reader, self.writer = await asyncio.open_connection(
                     host, port, limit=1 << 21
                 )
@@ -175,13 +203,81 @@ class _Session:
                 )
                 self.writer.write(Message(hello).seal().to_bytes())
                 await self.writer.drain()
-                return
             except OSError as e:
                 last = e
                 self.reader = self.writer = None
+                if n > 1:
+                    self.addr_ix += 1  # dead listener: rotate replicas
                 await asyncio.sleep(backoff)
                 backoff = min(backoff * 2, 1.0)
+                continue
+            if n > 1:
+                steered = await self._steer_to_primary(ix)
+                if steered is None:
+                    continue  # steering lost the connection: retry loop
+                ix = steered
+            if self._established_ix is not None and ix != self._established_ix:
+                self.lg.stats.failovers += 1
+            self._established_ix = ix
+            self.addr_ix = ix
+            return
         raise ConnectionError(f"session could not connect: {last!r}")
+
+    # How long to wait for the hello's PONG_CLIENT at connect time before
+    # giving up on steering (the peer may be mid-election and silent).
+    PONG_STEER_TIMEOUT = 1.0
+
+    async def _steer_to_primary(self, ix: int) -> Optional[int]:
+        """Multi-replica primary discovery at connect time: the hello's
+        PONG_CLIENT carries the replica's view, so one read steers the
+        session to `view % n` — replies only route over a connection the
+        PRIMARY holds for this client (a backup merely forwards the
+        request), so a session parked on a backup would time out every
+        roundtrip. Best-effort: a silent peer or an unreachable
+        advertised primary (mid-election) leaves the session where it
+        is and the roundtrip timeout rotates. Returns the established
+        address index, or None when the connection was lost."""
+        from tigerbeetle_tpu.net.bus import read_message
+
+        n = len(self.addresses)
+        try:
+            msg = await asyncio.wait_for(
+                read_message(self.reader), self.PONG_STEER_TIMEOUT
+            )
+        except asyncio.TimeoutError:
+            return ix
+        if msg is None:
+            self.kill_connection()
+            self.addr_ix += 1
+            return None
+        h = msg.header
+        if h["command"] != Command.PONG_CLIENT:
+            return ix  # replies already streaming: do not disturb
+        target = int(h["view"]) % n
+        if target == ix:
+            return ix
+        try:
+            host, port = self.addresses[target]
+            reader, writer = await asyncio.open_connection(
+                host, port, limit=1 << 21
+            )
+        except OSError:
+            # Advertised primary unreachable (it just died / is booting):
+            # stay put — the roundtrip path rotates on timeout.
+            return ix
+        self.kill_connection()
+        self.reader, self.writer = reader, writer
+        hello = hdr.make(
+            Command.PING_CLIENT, self.cluster, client=self.client_id
+        )
+        try:
+            self.writer.write(Message(hello).seal().to_bytes())
+            await self.writer.drain()
+        except OSError:
+            self.kill_connection()
+            self.addr_ix += 1
+            return None
+        return target
 
     def kill_connection(self) -> None:
         """Abrupt close (no FIN handshake) — the disconnect-storm model."""
@@ -239,12 +335,14 @@ class _Session:
         cid = self.client_id
         busy_retries = 0
         sends = 0
+        t_black: Optional[float] = None  # first failed attempt's send time
         while True:
             if self.client_id != cid:
                 raise _Rotated()  # frame is sealed under the OLD identity
             if self.writer is None:
                 await self._connect()
                 self.lg.stats.reconnects += 1
+            t_attempt = time.perf_counter()
             try:
                 self.writer.write(frame)
                 await self.writer.drain()
@@ -254,19 +352,44 @@ class _Session:
                 )
             except asyncio.TimeoutError:
                 self.lg.stats.timeouts += 1
+                if t_black is None:
+                    t_black = t_attempt
                 if sends > 8:
                     raise
+                if len(self.addresses) > 1:
+                    # The primary may have moved (a forwarded request's
+                    # reply can only route over the PRIMARY's connection
+                    # to us): reconnect so pong steering re-aims, instead
+                    # of resending into a dead view forever.
+                    self.kill_connection()
+                    self.addr_ix += 1
                 continue
             except (OSError, ConnectionResetError):
+                if t_black is None:
+                    t_black = t_attempt
                 self.kill_connection()
                 continue
             if reply.header["command"] == Command.BUSY:
+                if t_black is not None:
+                    # A BUSY proves the server is REACHABLE: the blackout
+                    # ends here — backoff time is shed telemetry, not
+                    # outage (docs/FRONT_DOOR.md "BUSY vs blackout").
+                    self.lg.stats.blackouts.append(
+                        time.perf_counter() - t_black
+                    )
+                    t_black = None
                 busy_retries += 1
                 self.lg.stats.sheds += 1
                 if busy_retries > BUSY_RETRY_MAX:
                     raise TimeoutError("persistently BUSY")
                 await asyncio.sleep(busy_backoff_s(busy_retries))
                 continue
+            if t_black is not None:
+                # Blackout closes at the first successful reply after the
+                # failure run (the client-perceived outage window).
+                self.lg.stats.blackouts.append(
+                    time.perf_counter() - t_black
+                )
             return reply
 
     async def register(self) -> None:
@@ -368,6 +491,7 @@ class LoadGen:
         churn: Sequence[Tuple[float, str, float]] = (),
         first_id: int = 1,
         cluster: int = 0,
+        request_timeout: Optional[float] = None,
     ) -> None:
         self.addresses = list(addresses)
         self.n_sessions = sessions
@@ -385,6 +509,13 @@ class LoadGen:
         ]
         for sess in self.sessions[:slow_readers]:
             sess.slow_s = slow_s
+        if request_timeout is not None:
+            # Failover runs shrink this: during an election every
+            # roundtrip to the old view burns one full timeout before the
+            # session rotates, so the default 5 s makes blackouts read as
+            # multiples of 5.
+            for sess in self.sessions:
+                sess.REQUEST_TIMEOUT = request_timeout
 
     # --- arrival generation --------------------------------------------
 
@@ -525,11 +656,10 @@ class LoadGen:
     ) -> dict:
         st = self.stats
         p = sorted(st.perceived)
+        b = sorted(st.blackouts)
 
-        def pct(q: float) -> float:
-            if not p:
-                return 0.0
-            return p[min(len(p) - 1, int(len(p) * q))] * 1e3
+        def pct(q: float, vals=None) -> float:
+            return percentile(p if vals is None else vals, q) * 1e3
 
         return {
             "sessions": self.n_sessions,
@@ -553,6 +683,14 @@ class LoadGen:
             "reconnects": st.reconnects,
             "timeouts": st.timeouts,
             "dropped": st.dropped,
+            # Failover telemetry (multi-replica address lists): sessions
+            # that re-established on a different replica, and the
+            # client-perceived blackout windows they crossed doing it.
+            "failover_count": st.failovers,
+            "blackouts": len(b),
+            "blackout_p50_ms": round(pct(0.50, b), 1),
+            "blackout_p99_ms": round(pct(0.99, b), 1),
+            "blackout_max_ms": round(b[-1] * 1e3, 1) if b else 0.0,
         }
 
 
@@ -621,7 +759,8 @@ def audit(
     sample = list(dict.fromkeys(int(i) for i in acked_sample))[:128]
     found = 0
     alive = 1
-    exception_dumps = -1
+    dumps = -1
+    exceptions = -1
     try:
         client = Client(addresses)
         for s in range(0, len(sample), 64):
@@ -632,16 +771,21 @@ def audit(
         alive = 0
     try:
         lc = _http_get_json(mport, "/lifecycle")
-        exception_dumps = int(lc.get("flight", {}).get("dumps", 0))
+        dumps = int(lc.get("flight", {}).get("dumps", 0))
+        # Exception trips specifically: a latency/stall anomaly dump is
+        # the recorder WORKING (an election trips it by design); a
+        # pipeline exception never legitimately happens.
+        exceptions = int(lc.get("flight", {}).get("exception_dumps", 0))
     except (OSError, ValueError):
         pass
-    ok = int(alive == 1 and found == len(sample))
+    ok = int(alive == 1 and found == len(sample) and exceptions <= 0)
     return {
         "ok": ok,
         "alive": alive,
         "acked_checked": len(sample),
         "acked_found": found,
-        "flight_dumps": exception_dumps,
+        "flight_dumps": dumps,
+        "flight_exceptions": exceptions,
     }
 
 

@@ -254,6 +254,7 @@ def reset() -> None:
         _op_hist[:] = array("q", _HIST_ZEROS)
         _op_window[0] = _op_window[1] = _op_window[2] = 0
         _flight["dumps"] = 0
+        _flight["exception_dumps"] = 0
         _flight["last_dump_ns"] = 0
 
 
@@ -436,6 +437,13 @@ _flight = {  # tidy: guarded-by=_registry_lock
     "cooldown_ns": 5_000_000_000,
     "dir": os.environ.get("TIGERBEETLE_TPU_FLIGHT_DIR", ""),
     "dumps": 0,
+    # Pipeline-exception trips specifically (flight_exception), counted
+    # even when the dump itself was rate-limited: "did an exception
+    # happen" must be answerable separately from "did a latency anomaly
+    # trip" — an election legitimately trips the stall rule, an
+    # exception never legitimately happens (the failover audit asserts
+    # this stays 0).
+    "exception_dumps": 0,
     "last_dump_ns": 0,
 }
 
@@ -713,7 +721,12 @@ def flight_trip(reason: str) -> Optional[str]:
 def flight_exception(reason: str) -> Optional[str]:
     """Pipeline-exception trip (stage poison / fail-stop dispatch): dump
     unconditionally of the latency rules — the causal window before a
-    crash is exactly what the recorder exists for."""
+    crash is exactly what the recorder exists for. Counted separately
+    from anomaly trips (and even when the dump was rate-limited) so an
+    audit can ask "did any exception happen" without false positives
+    from legitimate latency trips."""
+    with _registry_lock:
+        _flight["exception_dumps"] += 1
     return flight_trip(f"exception: {reason}")
 
 
@@ -778,7 +791,9 @@ def lifecycle_summary() -> dict:
     with _registry_lock:
         first, last, _n = _op_window
         flight = {
-            "dumps": _flight["dumps"], "ring": len(_op_ring),
+            "dumps": _flight["dumps"],
+            "exception_dumps": _flight["exception_dumps"],
+            "ring": len(_op_ring),
             "latency_mult": _flight["latency_mult"],
             "stall_ms": round(_flight["stall_ns"] / 1e6, 1),
         }

@@ -324,6 +324,19 @@ class Replica:
         self._recovery_stall_tripped = False
         self._recovery_gauge_last = -1
 
+        # View-change lifecycle observability (docs/CHAOS.md failover
+        # timeline, same taxonomy as recovery_stats): one episode spans
+        # leaving normal status to the new view serving. Phases — svc_wait
+        # (enter view_change → SVC quorum/DVC sent), dvc_collect (DVC sent
+        # → DVC quorum, new primary only), sv_replay (become primary →
+        # inherited suffix committed + re-proposed), sv_adopt (backup:
+        # enter → START_VIEW installed). Wall-clock, observability only —
+        # never reaches replicated state; mirrored as vsr.view_change.*
+        # gauges so a failover flight dump decomposes the blackout.
+        self.view_change_stats: Dict[str, float] = {}
+        self._vc_t0: Optional[float] = None
+        self._vc_dvc_t: Optional[float] = None
+
         # commit-number → checksum chain, used by the state checker. Ops at
         # or below checksum_floor were recovered from a checkpoint snapshot
         # and have no individually recorded checksum.
@@ -558,6 +571,11 @@ class Replica:
         self._recovery_progress_tick = self.tick_count
         self._recovery_progress_commit = self.commit_min
         self._recovery_stall_tripped = False
+        # Failover-timeline gauges (docs/CHAOS.md): which view this
+        # replica speaks and whether it is the one serving — a chaos
+        # harness scrapes these off /metrics to time an election.
+        tracer.gauge("vsr.view", self.view)
+        tracer.gauge("vsr.is_primary", int(self.is_primary))
         self.on_event("open", self)
 
     def _replay_exec(self, msg: Message, op: int) -> bool:
@@ -2633,8 +2651,19 @@ class Replica:
             self.log_view = self.view
         log.info("replica %d: view_change -> view %d", self.replica, new_view)
         tracer.count("mark.view_change_enter")
+        # View-change episode t0: a mid-change view bump (flap, dueling
+        # candidates) keeps the original stamp — the phases decompose the
+        # whole client-visible blackout, not the last ballot.
+        import time as _time
+
+        if self._vc_t0 is None:
+            self._vc_t0 = _time.perf_counter()  # tidy: allow=wall-clock — view-change observability only, never reaches replicated state
+            self.view_change_stats = {}
+        self._vc_dvc_t = None
         self.status = STATUS_VIEW_CHANGE
         self.view = max(self.view, new_view)
+        tracer.gauge("vsr.view", self.view)
+        tracer.gauge("vsr.is_primary", 0)
         self.last_heartbeat_tick = self.tick_count
         # The view promise must be durable BEFORE any DVC leaves this
         # replica (reference view_durable): a replica that votes, crashes,
@@ -2669,6 +2698,19 @@ class Replica:
         if self._dvc_sent_for_view >= v:
             return
         self._dvc_sent_for_view = v
+        if self._vc_t0 is not None:
+            # SVC-wait phase closes: quorum of start_view_change votes
+            # observed, our DVC leaves for the candidate primary.
+            import time as _time
+
+            self._vc_dvc_t = _time.perf_counter()  # tidy: allow=wall-clock — view-change observability only, never reaches replicated state
+            self.view_change_stats["svc_wait_s"] = round(
+                self._vc_dvc_t - self._vc_t0, 6
+            )
+            tracer.gauge(
+                "vsr.view_change.svc_wait_s",
+                self.view_change_stats["svc_wait_s"],
+            )
         # Advertise the WINNING log, not the raw journal: where a repair
         # target is pending the local journal content is stale, and a DVC
         # carrying it could win the candidate merge and resurrect divergent
@@ -2727,6 +2769,20 @@ class Replica:
             return
         if self.status != STATUS_VIEW_CHANGE or self.view != v:
             return
+
+        # DVC-collect phase closes: a quorum of logs is in hand — from
+        # here to serving is the new primary's replay/re-proposal work.
+        import time as _time
+
+        t_sv = _time.perf_counter()  # tidy: allow=wall-clock — view-change observability only, never reaches replicated state
+        if self._vc_dvc_t is not None:
+            self.view_change_stats["dvc_collect_s"] = round(
+                t_sv - self._vc_dvc_t, 6
+            )
+            tracer.gauge(
+                "vsr.view_change.dvc_collect_s",
+                self.view_change_stats["dvc_collect_s"],
+            )
 
         # Reference DVCQuorum: the winning log is defined by the DVCs with
         # the highest log_view (carried in `timestamp`); its length is their
@@ -2807,6 +2863,26 @@ class Replica:
                 self.bus.send_to_replica(r, m)
         self._commit_journal(self.commit_max)
         self._reproposal_pipeline(v)
+        # Start-view replay phase closes: the inherited suffix is
+        # committed (or re-proposed and in flight) and the new view
+        # serves. total_s is the primary-side blackout decomposition's
+        # sum-of-phases counterpart.
+        t_done = _time.perf_counter()  # tidy: allow=wall-clock — view-change observability only, never reaches replicated state
+        self.view_change_stats["sv_replay_s"] = round(t_done - t_sv, 6)
+        tracer.gauge(
+            "vsr.view_change.sv_replay_s",
+            self.view_change_stats["sv_replay_s"],
+        )
+        if self._vc_t0 is not None:
+            self.view_change_stats["total_s"] = round(t_done - self._vc_t0, 6)
+            tracer.gauge(
+                "vsr.view_change.total_s", self.view_change_stats["total_s"]
+            )
+        self._vc_t0 = None
+        self._vc_dvc_t = None
+        tracer.count("vsr.view_change.elected")
+        tracer.gauge("vsr.view", self.view)
+        tracer.gauge("vsr.is_primary", 1)
         self.on_event("view_change", self)
 
     @staticmethod
@@ -2888,9 +2964,26 @@ class Replica:
         self._quiesce_commit_stage()
         if self._recovery_active and self.status != STATUS_NORMAL:
             tracer.count("recovery.view_adopt")
+        if self._vc_t0 is not None:
+            # Backup-side episode closes: the elected primary's
+            # START_VIEW arrived and this replica re-enters normal.
+            import time as _time
+
+            self.view_change_stats["sv_adopt_s"] = round(
+                _time.perf_counter() - self._vc_t0, 6  # tidy: allow=wall-clock — view-change observability only, never reaches replicated state
+            )
+            tracer.gauge(
+                "vsr.view_change.sv_adopt_s",
+                self.view_change_stats["sv_adopt_s"],
+            )
+            self._vc_t0 = None
+            self._vc_dvc_t = None
+        tracer.count("vsr.view_change.adopted")
         self.view = v
         self.log_view = v
         self.status = STATUS_NORMAL
+        tracer.gauge("vsr.view", self.view)
+        tracer.gauge("vsr.is_primary", int(self.primary_index(v) == self.replica))
         self._recovery_pongs = {}
         self.last_heartbeat_tick = self.tick_count
 

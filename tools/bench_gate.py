@@ -105,6 +105,18 @@ GATED = (
     ("recovery", "grid_storm.degraded_throughput_pct", False),
     ("recovery", "torn_checkpoint.recovery_time_s", False),
     ("recovery", "torn_checkpoint.degraded_throughput_pct", False),
+    # Primary-failover objectives (ISSUE 11, docs/CHAOS.md): the one
+    # fault class users actually notice. view_change_time_s is the
+    # election blackout (primary crash → new view serving with commits
+    # past the fault tip); degraded_throughput_pct the dip across the
+    # whole fault→redundancy-restored window. Lower better, same >10%
+    # rule; n/a against pre-failover baselines; a crashed scenario
+    # records neither key → MISSING → fail-closed. primary_flap /
+    # partition_primary metrics are recorded but NOT gated (flap's
+    # worst-election and the partition's rejoin time scale with the
+    # scripted cycle counts, not with code quality).
+    ("recovery", "primary_kill.view_change_time_s", False),
+    ("recovery", "primary_kill.degraded_throughput_pct", False),
     # Front-door overload objectives (bench.py `overload` section: the
     # open-loop harness of testing/loadgen.py, docs/FRONT_DOOR.md). The
     # 1x point is the anchor: accepted throughput at the measured
