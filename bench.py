@@ -22,6 +22,7 @@ absolute numbers — BASELINE.md). North star: 5M/s (BASELINE.json).
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -799,41 +800,109 @@ def bench_recovery():
     return out
 
 
-def main() -> None:
+# Section registry, in execution order. The ordering is load-bearing:
+# the first three fork server/client processes onto this host's cores
+# and the parent must not yet hold jax runtime threads (device dispatch/
+# tunnel keepalive) competing for them — end_to_end first, then the
+# recovery and overload sections (loadgen/chaos are numpy + asyncio
+# only), and only then the in-parent device configs that import jax.
+SECTIONS = (
+    ("end_to_end", bench_e2e),
+    ("recovery", bench_recovery),
+    ("overload", bench_overload),
+    ("config1_default", bench_config1),
+    ("config2_zipf", bench_config2_zipf),
+    ("config3_linked_pending", lambda: bench_exact("config3")),
+    ("config4_balancing_limits", lambda: bench_exact("config4")),
+    ("config5_lsm", bench_config5_lsm),
+)
+
+SECTION_NAMES = tuple(name for name, _ in SECTIONS)
+
+
+def select_sections(spec: str | None):
+    """Resolve a --sections comma-list against the registry, preserving
+    the registry's (load-bearing) execution order. None/"" = full run.
+    Unknown names raise ValueError naming the valid set."""
+    if not spec:
+        return SECTIONS
+    wanted = [s.strip() for s in spec.split(",") if s.strip()]
+    unknown = [s for s in wanted if s not in SECTION_NAMES]
+    if unknown:
+        raise ValueError(
+            f"unknown bench section(s) {', '.join(unknown)} — valid: "
+            f"{', '.join(SECTION_NAMES)}"
+        )
+    chosen = set(wanted)
+    return tuple((n, f) for n, f in SECTIONS if n in chosen)
+
+
+def build_record(results: dict, sections) -> dict:
+    """The one devhub/BENCH record for a run: headline metric, the
+    per-section `extra` blocks, the environment fingerprint
+    (docs/DEVHUB.md) recorded top-level in extra["env"] and echoed as
+    profile_id per section, and — for --sections runs — the partial
+    marker so tools/bench_gate.py reports skipped sections as n/a (not
+    MISSING) and tools/devhub.py treats absent keys as series gaps,
+    never regressions."""
+    # Fingerprint AFTER the sections ran: fingerprint(allow_jax=True)
+    # imports jax, and the parent must stay jax-free until the forked
+    # sections (e2e/recovery/overload) are done.
+    from tigerbeetle_tpu import envprofile
+
+    env = envprofile.fingerprint(allow_jax=True)
+    results = dict(results)
+    for block in results.values():
+        if isinstance(block, dict):
+            block.setdefault("profile_id", env["profile_id"])
+    results["env"] = env
+    primary = results.get("config1_default")
+    full = len(sections) == len(SECTIONS)
+    record = {
+        "metric": "posted_transfers_per_sec",
+        "value": (
+            float(primary.get("posted_per_s", 0.0))
+            if isinstance(primary, dict) else None
+        ),
+        "unit": "tx/s",
+        "extra": results,
+    }
+    if record["value"] is not None:
+        record["vs_baseline"] = round(record["value"] / BASELINE_TPS, 3)
+    if not full:
+        record["partial"] = True
+        record["sections"] = [n for n, _ in sections]
+    return record
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        prog="bench", description="benchmark matrix (docs/DEVHUB.md)"
+    )
+    ap.add_argument(
+        "--sections", default=None,
+        help="comma-list of sections to run (e.g. "
+             "--sections=end_to_end,overload) — a partial devhub run "
+             "that skips the full ~160s matrix; skipped sections are "
+             "recorded as absent and the record marks itself partial. "
+             f"Valid: {', '.join(SECTION_NAMES)}",
+    )
+    args = ap.parse_args(argv)
+    try:
+        sections = select_sections(args.sections)
+    except ValueError as e:
+        ap.error(str(e))
+
     t_start = time.perf_counter()
     results = {}
-    for name, fn in (
-        # End-to-end FIRST: it forks a server+client pair onto this host's
-        # single core, and the parent must not yet hold jax runtime
-        # threads (device dispatch/tunnel keepalive) competing for it.
-        ("end_to_end", bench_e2e),
-        # Recovery next, while the parent is still jax-free: the
-        # kill/restart scenario forks its own replica processes too.
-        ("recovery", bench_recovery),
-        # Overload likewise forks its replica and keeps the parent
-        # jax-free (loadgen is numpy + asyncio only).
-        ("overload", bench_overload),
-        ("config1_default", bench_config1),
-        ("config2_zipf", bench_config2_zipf),
-        ("config3_linked_pending", lambda: bench_exact("config3")),
-        ("config4_balancing_limits", lambda: bench_exact("config4")),
-        ("config5_lsm", bench_config5_lsm),
-    ):
+    for name, fn in sections:
         try:
             results[name] = fn()
         except Exception as e:  # noqa: BLE001 — a config failure must not kill the matrix
             results[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
-    primary = results.get("config1_default", {})
-    posted_per_s = float(primary.get("posted_per_s", 0.0))
     results["bench_wall_s"] = round(time.perf_counter() - t_start, 1)
-    record = {
-        "metric": "posted_transfers_per_sec",
-        "value": posted_per_s,
-        "unit": "tx/s",
-        "vs_baseline": round(posted_per_s / BASELINE_TPS, 3),
-        "extra": results,
-    }
+    record = build_record(results, sections)
     # devhub-style local time series (reference devhub.zig:36-52): every
     # bench run appends one JSON line so regressions are visible over time.
     try:

@@ -350,6 +350,21 @@ def _http_get_json(port: int, path: str, timeout: float = 10.0):
     return json.loads(body)
 
 
+def _emit_bench_json(result: dict, args) -> None:
+    """Stamp the environment fingerprint (docs/DEVHUB.md — backend +
+    host + accelerator profile, so a BENCH_JSON line from a TPU host is
+    distinguishable from this container by construction) and print the
+    one machine-readable line both benchmark loops share. Called after
+    the timed phases only: fingerprint() may import jax."""
+    import json
+
+    from tigerbeetle_tpu.envprofile import fingerprint
+
+    result["backend"] = args.backend
+    result["env"] = fingerprint()
+    print("BENCH_JSON " + json.dumps(result), flush=True)
+
+
 def cmd_benchmark(args) -> int:
     """Spawn a temp single-replica cluster and run the load (reference
     benchmark_driver.zig + benchmark_load.zig). For the pure device-kernel
@@ -472,7 +487,7 @@ def cmd_benchmark(args) -> int:
                         result["lifecycle_ops"] = lc.get("ops", 0)
                     except (OSError, ValueError) as e:
                         print(f"lifecycle scrape failed: {e}", file=sys.stderr)
-                print("BENCH_JSON " + json.dumps(result), flush=True)
+                _emit_bench_json(result, args)
                 return 0
 
             # Pipelined load via the AsyncClient session pool (reference
@@ -620,7 +635,7 @@ def cmd_benchmark(args) -> int:
                 print(f"query latency p90 = {q90 * 1e3:.2f} ms")
             # The machine-readable result line (bench.py parses this;
             # the regex over the human lines above is only a fallback).
-            print("BENCH_JSON " + json.dumps(result), flush=True)
+            _emit_bench_json(result, args)
         finally:
             proc.terminate()
             try:

@@ -60,6 +60,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import sys
 import threading
 import time
 from array import array
@@ -1273,10 +1274,26 @@ def _git_revision() -> str:
 def devhub_append(path: str, record: dict) -> None:
     """Append one benchmark record to the JSON-lines series
     (devhub.zig:36-52's git-backed database, minus the git): stamped
-    with the wall clock and the current git revision so every row is
-    attributable to a commit."""
+    with the wall clock, the current git revision, and the environment
+    profile_id (docs/DEVHUB.md) so every row is attributable to a
+    commit AND a machine. Records that already carry a fingerprint
+    (bench.py puts the full one in extra["env"]) keep it; otherwise the
+    stamp is computed here — jax-aware only when jax is already loaded,
+    so a jax-free caller (bench_gate) never pulls in the runtime."""
     rec = dict(record)
     rec.setdefault("unix_timestamp", int(time.time()))
     rec.setdefault("git", _git_revision())
+    if "profile_id" not in rec:
+        try:
+            from tigerbeetle_tpu import envprofile
+
+            rec["profile_id"] = envprofile.record_profile_id(rec) if (
+                isinstance(rec.get("extra"), dict)
+                and isinstance(rec["extra"].get("env"), dict)
+            ) else envprofile.fingerprint(
+                allow_jax="jax" in sys.modules
+            )["profile_id"]
+        except Exception:  # noqa: BLE001 — a stamp failure must not lose the row
+            pass
     with open(path, "a") as f:
         f.write(json.dumps(rec) + "\n")

@@ -16,17 +16,39 @@ workload by bench.py via the tidy compile registry) are gated EXACTLY:
 any drift from the baselined value means a retrace crept into the hot
 path, which fails the gate the same way a >10% perf drop does.
 
+Like-for-like gating (docs/DEVHUB.md): every bench run carries an
+environment fingerprint (tigerbeetle_tpu/envprofile.py — host + the
+accelerator jax would use, hashed into a stable `profile_id`). The gate
+REFUSES a numeric verdict when candidate and baseline profiles differ:
+a TPU-host run "regressing" against a 2-core-container baseline (or the
+reverse "improving") is a hardware difference, not a code change, so
+every row reports `n/a (profile mismatch)` and the exit is 2 — not
+pass, not fail. Baselines recorded before fingerprinting existed
+(BENCH_r01-r05) are adopted as the dev-container profile
+(envprofile.LEGACY_PROFILE) so the existing trajectory keeps gating.
+`--profile` switches baseline selection from "newest BENCH_r*.json" to
+"newest BENCH_*.json whose profile matches the candidate" — the
+like-for-like selector for hosts that keep parallel trajectories
+(BENCH_r06.json next to BENCH_tpu_r01.json).
+
+A run produced by `bench.py --sections=...` marks itself partial: gated
+keys in sections it deliberately skipped report `n/a (section skipped)`
+instead of MISSING — the fail-closed MISSING semantics are unchanged
+for full runs (a crashed section still fails against any baseline that
+recorded it).
+
 Usage:
     python bench.py | tee /tmp/bench.json
     python tools/bench_gate.py /tmp/bench.json         # file with the JSON line
     python bench.py | python tools/bench_gate.py -     # stdin
     python tools/bench_gate.py --current-json '<json>' # inline
+    python tools/bench_gate.py --profile /tmp/bench.json  # like-for-like baseline
     python tools/bench_gate.py --list                  # gated metrics + thresholds
 
 Exit codes: 0 pass, 1 regression, 2 usage/missing-data (no baseline
-recorded, no parsable bench output). Every gate run appends a record to
-devhub.jsonl so the pass/fail history rides the same series as the
-bench numbers (reference devhub.zig:36-52).
+recorded, no parsable bench output, profile mismatch). Every gate run
+appends a record to devhub.jsonl so the pass/fail history rides the
+same series as the bench numbers (reference devhub.zig:36-52).
 
 The e2e bar this repo is chasing (ROADMAP.md open items): end_to_end
 load_accepted_tx_per_s ≥ 1,000,000 and perceived_p50_ms ≤ 10 — the gate
@@ -43,6 +65,8 @@ import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
 # >10% worse than the recorded round fails the gate.
 THROUGHPUT_REGRESSION = 0.10
@@ -151,31 +175,130 @@ GATED_EXACT = (
 )
 
 
-def latest_round_extra() -> tuple:
-    """(round, extra dict) from the newest BENCH_r*.json."""
-    rounds = []
-    for path in glob.glob(os.path.join(REPO, "BENCH_r*.json")):
-        m = re.search(r"BENCH_r(\d+)\.json$", path)
-        if m:
-            rounds.append((int(m.group(1)), path))
+def profile_of_extra(extra: dict) -> str:
+    """The profile_id a bench `extra` block belongs to. Fingerprinted
+    runs carry it in extra["env"] (a bare BENCH_JSON wrapped as
+    {"end_to_end": rec} carries it inside the section); legacy
+    artifacts adopt the dev-container profile
+    (envprofile.LEGACY_PROFILE) so the r01-r05 trajectory keeps gating
+    on the host it was recorded on."""
+    from tigerbeetle_tpu import envprofile
+
+    for block in (extra or {}), (extra or {}).get("end_to_end") or {}:
+        env = block.get("env")
+        if isinstance(env, dict) and env.get("profile_id"):
+            return str(env["profile_id"])
+    return envprofile.legacy_profile_id()
+
+
+def baseline_files() -> tuple:
+    """(files, errors, skipped): every BENCH_*.json round file as
+    (sort_key, name, extra), oldest first. sort_key is (round number
+    parsed from the trailing r<NN>, mtime) so BENCH_r05 < BENCH_r06 and
+    BENCH_tpu_r01 sorts by its own round counter within the tpu
+    trajectory.
+
+    `errors` (name, reason) are UNPARSABLE files — a truncated newest
+    baseline must not silently demote the gate to an older round, so
+    main() refuses to gate (exit 2) while any exist. `skipped` are
+    parsable files without an end_to_end section: legacy pre-sectioned
+    schemas (BENCH_r01/r02 predate the section layout) — expected,
+    warned about, never fatal."""
+    out, errors, skipped = [], [], []
+    for path in glob.glob(os.path.join(REPO, "BENCH_*.json")):
+        name = os.path.basename(path)
+        m = re.search(r"r(\d+)\.json$", name)
+        rnd = int(m.group(1)) if m else -1
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError) as e:
+            errors.append((name, f"{type(e).__name__}: {e}"))
+            continue
+        parsed = rec.get("parsed") or rec  # raw bench JSON also accepted
+        extra = parsed.get("extra") if isinstance(parsed, dict) else None
+        if not isinstance(extra, dict) or "end_to_end" not in extra:
+            skipped.append((name, "no end_to_end block (legacy schema)"))
+            continue
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            mtime = 0.0
+        out.append(((rnd, mtime), name, extra))
+    out.sort(key=lambda t: t[0])
+    return out, errors, skipped
+
+
+def select_round(files) -> tuple:
+    """(name, extra dict) of the newest BENCH_r*.json among the loaded
+    `files` (the default trajectory; profile-agnostic — main() enforces
+    the match)."""
+    rounds = [
+        (key, name, extra)
+        for key, name, extra in files
+        if re.fullmatch(r"BENCH_r(\d+)\.json", name)
+    ]
     if not rounds:
-        return 0, None
-    n, path = max(rounds)
-    with open(path) as f:
-        rec = json.load(f)
-    parsed = rec.get("parsed") or rec  # raw bench JSON also accepted
-    extra = parsed.get("extra")
-    if not isinstance(extra, dict) or "end_to_end" not in extra:
-        return n, None
-    return n, extra
+        return None, None
+    _, name, extra = rounds[-1]
+    return name, extra
 
 
-def extract_extra(text: str):
-    """Pull the bench `extra` blocks out of bench.py's output (the JSON
+def select_matching(files, profile_id: str) -> tuple:
+    """(name, extra dict) of the newest file among `files` whose
+    profile matches `profile_id` (--profile auto-selection)."""
+    matches = [
+        (key, name, extra)
+        for key, name, extra in files
+        if profile_of_extra(extra) == profile_id
+    ]
+    if not matches:
+        return None, None
+    _, name, extra = matches[-1]
+    return name, extra
+
+
+def _trajectory_of(name: str) -> tuple:
+    """(prefix, round) of a round-file name: BENCH_r05.json →
+    ("BENCH_", 5), BENCH_tpu_r01.json → ("BENCH_tpu_", 1). Round
+    counters restart per trajectory prefix, so cross-prefix round
+    comparison is meaningless; non-round names get round -1."""
+    m = re.search(r"r(\d+)\.json$", name)
+    if not m:
+        return name, -1
+    return name[:m.start()], int(m.group(1))
+
+
+def newer_skipped(skipped, selected_name) -> list:
+    """Skipped (legacy-schema) files in the SAME trajectory as the
+    selected baseline with a HIGHER round number: the silent-demotion
+    hazard — someone saved a partial/wrong-shape run as the newest
+    round file, and gating would quietly fall back to an older round.
+    Fatal in main(). The ancient pre-section BENCH_r01/r02 sort below
+    every modern default-trajectory baseline, and a parallel
+    trajectory's files (BENCH_tpu_r*.json) are a different prefix with
+    their own round counter — neither trips this."""
+    if not selected_name:
+        return []
+    sel_prefix, sel_rnd = _trajectory_of(selected_name)
+    out = []
+    for name, reason in skipped:
+        prefix, rnd = _trajectory_of(name)
+        if prefix == sel_prefix and rnd > sel_rnd:
+            out.append((name, reason))
+    return out
+
+
+def extract_record(text: str):
+    """Pull the full bench record out of bench.py's output (the JSON
     line may be surrounded by warnings/log noise). A bare end_to_end
-    block is accepted too (wrapped as {"end_to_end": block})."""
+    block is accepted too (wrapped as {"extra": {"end_to_end": block}}),
+    including the `BENCH_JSON {...}` line exactly as `cli.py benchmark`
+    prints it — so a raw driver run gates directly."""
     for line in text.splitlines():
         line = line.strip()
+        if line.startswith("BENCH_JSON "):
+            line = line[len("BENCH_JSON "):]
         if not line.startswith("{"):
             continue
         try:
@@ -184,10 +307,27 @@ def extract_extra(text: str):
             continue
         extra = rec.get("extra")
         if isinstance(extra, dict) and "end_to_end" in extra:
-            return extra
+            return rec
+        if (isinstance(extra, dict) and rec.get("partial")
+                and isinstance(rec.get("sections"), list)):
+            # A --sections run that deliberately excluded end_to_end
+            # still gates what it DID measure (the e2e keys become
+            # n/a (section skipped) downstream).
+            return rec
         if "load_accepted_tx_per_s" in rec:
-            return {"end_to_end": rec}
+            # A bare driver record measures ONLY the serving path: mark
+            # it partial so the other gated sections report n/a
+            # (section skipped) instead of MISSING-failing a run that
+            # never claimed to cover them.
+            return {"extra": {"end_to_end": rec}, "partial": True,
+                    "sections": ["end_to_end"]}
     return None
+
+
+def extract_extra(text: str):
+    """Back-compat shim: the `extra` dict of extract_record()."""
+    rec = extract_record(text)
+    return rec["extra"] if rec is not None else None
 
 
 def main(argv=None) -> int:
@@ -198,24 +338,37 @@ def main(argv=None) -> int:
                    help="bench JSON passed inline instead of a file")
     p.add_argument("--devhub", default=os.path.join(REPO, "devhub.jsonl"),
                    help="series file to append the gate record to")
+    p.add_argument("--profile", action="store_true",
+                   help="select the newest BENCH_*.json whose environment "
+                        "profile matches the current run (like-for-like; "
+                        "legacy files count as the dev-container profile) "
+                        "instead of the newest BENCH_r*.json")
     p.add_argument("--list", action="store_true",
                    help="print the gated metrics and current thresholds, then exit")
     args = p.parse_args(argv)
 
     if args.list:
-        rnd, baseline = latest_round_extra()
-        src = f"BENCH_r{rnd:02d}.json" if baseline is not None else "(no baseline)"
-        print(f"gated metrics (baseline: {src}):")
+        files, errors, skipped = baseline_files()
+        for bad_name, reason in errors + skipped:
+            print(f"bench_gate: WARNING: skipping baseline {bad_name}: "
+                  f"{reason}", file=sys.stderr)
+        name, baseline = select_round(files)
+        src = name if baseline is not None else "(no baseline)"
+        base_profile = (
+            profile_of_extra(baseline) if baseline is not None else "—"
+        )
+        print(f"gated metrics (baseline: {src}, profile={base_profile}):")
         for section, key, higher in GATED:
             base = lookup((baseline or {}).get(section) or {}, key)
             rule = ("≥ baseline × 0.90" if higher else "≤ baseline × 1.10")
             base_s = f"{float(base):,.1f}" if base is not None else "—"
-            print(f"  {section}.{key:32s} {rule:22s} baseline={base_s}")
+            print(f"  {section}.{key:32s} {rule:22s} baseline={base_s}  "
+                  f"profile={base_profile}")
         for section, key in GATED_EXACT:
             base = (baseline or {}).get(section, {}).get(key)
             base_s = f"{base}" if base is not None else "—"
             print(f"  {section}.{key:32s} {'== baseline (exact)':22s} "
-                  f"baseline={base_s}")
+                  f"baseline={base_s}  profile={base_profile}")
         return 0
 
     if args.current_json is not None:
@@ -225,22 +378,104 @@ def main(argv=None) -> int:
     else:
         with open(args.current) as f:
             text = f.read()
-    current = extract_extra(text)
-    if current is None:
+    record = extract_record(text)
+    if record is None:
         print(
             "bench_gate: no end_to_end block found in the input — expected "
             "bench.py's JSON output line (run `python bench.py | python "
             "tools/bench_gate.py -`)", file=sys.stderr,
         )
         return 2
-    rnd, baseline = latest_round_extra()
-    if baseline is None:
+    current = record["extra"]
+    cand_profile = profile_of_extra(current)
+    partial_sections = None
+    if record.get("partial") and isinstance(record.get("sections"), list):
+        partial_sections = set(record["sections"])
+
+    files, bad_baselines, skipped = baseline_files()
+    if bad_baselines:
+        # Fail loudly rather than quietly gating against an OLDER round:
+        # a truncated BENCH_r06.json must not let a PR pass vs BENCH_r05
+        # with nobody noticing the intended baseline never loaded.
+        for bad_name, reason in bad_baselines:
+            print(f"bench_gate: unreadable baseline {bad_name}: {reason}",
+                  file=sys.stderr)
+        print("bench_gate: fix or remove the corrupt BENCH_*.json file(s) "
+              "above — refusing to gate against a possibly-stale older "
+              "baseline.", file=sys.stderr)
+        return 2
+
+    if args.profile:
+        name, baseline = select_matching(files, cand_profile)
+        if baseline is None:
+            print(
+                f"bench_gate: no BENCH_*.json baseline with profile "
+                f"{cand_profile} under {REPO} — record one first (save "
+                "bench.py's JSON output as BENCH_<host>_r<NN>.json) or gate "
+                "against the default trajectory without --profile.",
+                file=sys.stderr,
+            )
+            return 2
+    else:
+        name, baseline = select_round(files)
+        if baseline is None:
+            print(
+                f"bench_gate: no BENCH_r*.json baseline found under {REPO} — "
+                "nothing to gate against. Record one first (save bench.py's "
+                "JSON output as BENCH_r<NN>.json) or run --list to see the "
+                "gated metrics.", file=sys.stderr,
+            )
+            return 2
+    demoting = newer_skipped(skipped, name)
+    if demoting:
+        # Same silent-demotion hazard as an unreadable file, parsable
+        # edition: a wrong-shape run saved as the newest round must not
+        # quietly hand the gate an older baseline.
+        for skip_name, reason in demoting:
+            print(f"bench_gate: baseline {skip_name} is newer than the "
+                  f"selected {name} but unusable: {reason}", file=sys.stderr)
+        print("bench_gate: fix or remove the file(s) above (only full "
+              "bench.py runs can be round baselines) — refusing to gate "
+              "against the older round.", file=sys.stderr)
+        return 2
+    base_profile = profile_of_extra(baseline)
+
+    if base_profile != cand_profile:
+        # Like-for-like refusal: a numeric verdict across hardware
+        # profiles compares the machines, not the code. Loud n/a + exit
+        # 2 — never pass, never numeric fail (docs/DEVHUB.md).
+        print(f"bench gate vs {name}: n/a (profile mismatch)")
+        for section, key, _ in GATED:
+            print(f"  {section}.{key}  n/a (profile mismatch)")
+        for section, key in GATED_EXACT:
+            print(f"  {section}.{key}  n/a (profile mismatch)")
         print(
-            f"bench_gate: no BENCH_r*.json baseline found under {REPO} — "
-            "nothing to gate against. Record one first (save bench.py's "
-            "JSON output as BENCH_r<NN>.json) or run --list to see the "
-            "gated metrics.", file=sys.stderr,
+            f"bench_gate: profile mismatch — current run profile="
+            f"{cand_profile}, baseline {name} profile={base_profile}: "
+            "like-for-like gating refuses a numeric verdict across "
+            "environments. Re-run with --profile to auto-select a matching "
+            "BENCH_*.json, or record a first baseline for this profile "
+            "(docs/DEVHUB.md).", file=sys.stderr,
         )
+        try:
+            from tigerbeetle_tpu import tracer
+
+            # value=None, not 0: a refused verdict must never read as a
+            # clean pass to anyone scanning the series for fail counts.
+            tracer.devhub_append(args.devhub, {
+                "metric": "bench_gate",
+                "value": None,
+                "unit": "fail_count",
+                "verdict": "profile_mismatch",
+                "extra": {
+                    "baseline_file": name,
+                    "profile_mismatch": {
+                        "current": cand_profile, "baseline": base_profile,
+                    },
+                },
+            })
+        except OSError:
+            pass
         return 2
 
     failed = []
@@ -252,11 +487,18 @@ def main(argv=None) -> int:
         cur_raw = lookup(cur_sec, key)
         base_raw = lookup(base_sec, key)
         if cur_raw is None:
+            base = float(base_raw) if base_raw is not None else None
+            if (partial_sections is not None
+                    and section not in partial_sections):
+                # bench.py --sections deliberately skipped this section:
+                # n/a, never a MISSING failure (partial devhub runs don't
+                # gate the sections they never measured).
+                rows.append((label, None, base, "n/a (section skipped)"))
+                continue
             # A section the current run skipped/errored FAILS the gate
             # whenever the baseline recorded it (a crashed bench must
             # not pass as "no regression"); when the baseline never
             # recorded it either, there is nothing to compare (n/a).
-            base = float(base_raw) if base_raw is not None else None
             if base is not None:
                 failed.append(label)
             rows.append((
@@ -290,6 +532,10 @@ def main(argv=None) -> int:
             rows.append((label, cur, None, "n/a"))
             continue
         if cur is None:
+            if (partial_sections is not None
+                    and section not in partial_sections):
+                rows.append((label, None, float(base), "n/a (section skipped)"))
+                continue
             failed.append(label)
             rows.append((label, None, float(base),
                          "MISSING (section absent from current run)"))
@@ -303,7 +549,8 @@ def main(argv=None) -> int:
         ))
 
     width = max(len(k) for k, *_ in rows)
-    print(f"bench gate vs BENCH_r{rnd:02d}.json (>10% regression fails):")
+    print(f"bench gate vs {name} (>10% regression fails; "
+          f"profile={cand_profile}):")
     for label, cur, base, verdict in rows:
         cur_s = f"{cur:,.1f}" if cur is not None else "—"
         base_s = f"{base:,.1f}" if base is not None else "—"
@@ -316,8 +563,9 @@ def main(argv=None) -> int:
             "metric": "bench_gate",
             "value": len(failed),
             "unit": "fail_count",
+            "profile_id": cand_profile,
             "extra": {
-                "baseline_round": rnd,
+                "baseline_file": name,
                 "current": {
                     f"{s}.{k}": lookup(current.get(s) or {}, k)
                     for s, k in [(s, k) for s, k, _ in GATED] + list(GATED_EXACT)
@@ -339,5 +587,4 @@ def main(argv=None) -> int:
 
 
 if __name__ == "__main__":
-    sys.path.insert(0, REPO)
     sys.exit(main())

@@ -4,15 +4,21 @@
 Runs the full analyzer suite — ownership/lockset, determinism lint,
 marker scan, and the device hot-path passes (host-sync, retrace,
 reduction, absint) — against the repo and gates on the shared baseline
-(tigerbeetle_tpu/tidy/baseline.json). CI and tier-1 call exactly this
-(tests/test_tidy.py::test_repo_has_no_new_findings runs the same
-check()); tools/tidy_check.py remains as a thin alias.
+(tigerbeetle_tpu/tidy/baseline.json), then the devhub pass: the
+perf-trajectory change-point detector (tools/devhub.py, docs/DEVHUB.md)
+over devhub.jsonl. The devhub pass is ADVISORY by default (steps are
+reported, exit code unaffected) and strict under --strict-new, where an
+unacknowledged regression step — or a trailing regression-ward suspect
+run — fails this entry point like any analyzer finding. CI and tier-1
+call exactly this (tests/test_tidy.py::test_repo_has_no_new_findings
+runs the same check()); tools/tidy_check.py remains as a thin alias.
 
     python tools/check.py                  # human report, exit 1 on new findings
     python tools/check.py --json           # machine-readable
     python tools/check.py --passes host-sync retrace absint
     python tools/check.py --write-baseline # accept current findings
     python tools/check.py --strict-stale   # rotted baseline entries fail too
+    python tools/check.py --strict-new     # devhub regression steps fail too
 
 Annotation syntax and the suppression workflow: docs/STATIC_ANALYSIS.md.
 """
@@ -24,8 +30,41 @@ import json
 import pathlib
 import sys
 
-REPO = pathlib.Path(__file__).resolve().parents[1]
+TOOLS = pathlib.Path(__file__).resolve().parent
+REPO = TOOLS.parents[0]
 sys.path.insert(0, str(REPO))
+
+
+def check_devhub(strict_new: bool = False) -> dict:
+    """The devhub pass: change-point detection over the repo's
+    devhub.jsonl (tools/devhub.py). Returns {ran, failures, steps};
+    never raises. A missing series file is a benign skip (the analyzer
+    passes must keep gating where benchmarks never ran) — but an ERROR
+    (malformed devhub_ack.json, a broken devhub.py) is reported as a
+    failure row so the --strict-new gate fails CLOSED: a corrupt ack
+    file must never silently ignore every acknowledgement AND wave the
+    regressions through (load_acks' contract)."""
+    if str(TOOLS) not in sys.path:
+        sys.path.insert(0, str(TOOLS))
+    try:
+        import devhub
+
+        if not pathlib.Path(devhub.DEFAULT_DEVHUB).exists():
+            return {"ran": False, "failures": [], "steps": 0,
+                    "note": "no devhub.jsonl"}
+        analysis = devhub.analyze(devhub.DEFAULT_DEVHUB, devhub.DEFAULT_ACK)
+        failures = devhub.check_failures(analysis, strict_new=strict_new)
+        steps = sum(
+            len(m["steps"])
+            for p in analysis["profiles"] for m in p["metrics"]
+        )
+        return {"ran": True, "failures": failures, "steps": steps}
+    except Exception as e:  # noqa: BLE001 — pass errors fail closed, not loudly crash
+        err = f"{type(e).__name__}: {e}"
+        return {"ran": False, "steps": 0, "note": err, "failures": [
+            f"devhub pass errored ({err}) — fix it or the ack file; "
+            "the trajectory gate fails closed, not open"
+        ]}
 
 
 def _pass_names():
@@ -74,6 +113,12 @@ def main(argv=None) -> int:
         "--strict-stale", action="store_true",
         help="also fail when the baseline contains entries nothing produces",
     )
+    ap.add_argument(
+        "--strict-new", action="store_true",
+        help="devhub pass is strict: an unacknowledged perf-regression "
+             "change-point (or trailing suspect run) in devhub.jsonl "
+             "fails this entry point (advisory otherwise; docs/DEVHUB.md)",
+    )
     args = ap.parse_args(argv)
 
     if args.write_baseline:
@@ -90,6 +135,14 @@ def main(argv=None) -> int:
         return 0
 
     report = check(args.root, args.passes, args.baseline)
+    # Eighth pass — perf-trajectory change points (advisory unless
+    # --strict-new): only against THIS repo's series (a --root override
+    # analyzes someone else's tree; their devhub history is not ours).
+    devhub_report = (
+        check_devhub(args.strict_new) if args.root is None
+        else {"ran": False, "failures": [], "steps": 0, "note": "root override"}
+    )
+    report["devhub"] = devhub_report
 
     if args.json:
         print(json.dumps(report, indent=2))
@@ -102,14 +155,25 @@ def main(argv=None) -> int:
                   f"{f['scope']}: {f['subject']}")
         for k in report["stale_baseline_keys"]:
             print(f"stale baseline entry: {k}")
+        mode = "strict" if args.strict_new else "advisory"
+        for f in devhub_report["failures"]:
+            print(f"devhub ({mode}): {f}")
+        if devhub_report["ran"]:
+            print(f"devhub: {devhub_report['steps']} change-point(s), "
+                  f"{len(devhub_report['failures'])} unacknowledged "
+                  f"regression(s) ({mode})")
+        else:
+            print(f"devhub: skipped ({devhub_report.get('note', '')})")
         print(
             f"check: {len(report['new'])} new, {len(report['suppressed'])} "
             f"baselined, {len(report['stale_baseline_keys'])} stale "
-            f"(passes: {', '.join(report['passes'])})"
+            f"(passes: {', '.join(report['passes'])} + devhub)"
         )
     if report["new"]:
         return 1
     if args.strict_stale and report["stale_baseline_keys"]:
+        return 1
+    if args.strict_new and devhub_report["failures"]:
         return 1
     return 0
 

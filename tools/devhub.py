@@ -1,0 +1,859 @@
+"""Continuous-benchmarking devhub: change-point detection + trajectory
+report over devhub.jsonl (reference devhub.zig:36-57 — per-merge
+metrics in a git-backed JSON database rendered by devhub.js, pushed to
+nyrkio for change-point detection; this is the offline analog).
+
+The >10% bench_gate rule catches single-PR cliffs; it is structurally
+blind to slow drift (three consecutive -8% rounds each pass the gate
+and compound to -22%). This tool reads the full per-merge trajectory
+and finds the steps:
+
+  report   per-metric table — current value, regime median, detected
+           change-points annotated with the git-rev window that
+           introduced them and their acknowledgement state.
+  check    exit non-zero on an unacknowledged regression step
+           (--strict-new also fails on a trailing suspect: the newest
+           run deviating regression-ward from its regime before a
+           second run confirms it as a step). tools/check.py runs this
+           as its devhub pass — advisory by default, strict under
+           check.py --strict-new.
+  html     self-contained static dashboard (devhub.js analog): one
+           annotated sparkline per gated metric, change-points marked,
+           plus a table view per metric. Written to devhub.html.
+
+Detector: offline e-divisive/CUSUM-style binary segmentation on
+rank/median statistics (detect_change_points), built for this host's
+±10% run noise — a split is a change-point only when the median shift
+clears both an absolute floor and a multiple of the pooled MAD, AND the
+cross-segment rank order is consistent (a lone outlier cannot fake a
+regime). A new regime needs ≥2 runs of evidence before it is a
+confirmed step; the single newest deviating run is surfaced separately
+as a *suspect* under --strict-new.
+
+Series are grouped by environment profile (tigerbeetle_tpu/envprofile):
+a TPU-host trajectory never mixes with the dev-container one. Rows
+recorded before fingerprinting existed adopt the dev-container profile
+(LEGACY_PROFILE) so the r01+ history reads as one series. Rows missing
+a metric (pre-lifecycle rounds, `bench.py --sections` partial runs)
+are gaps, never crashes and never regressions.
+
+Intentional steps (a host change, an accepted trade-off) are
+acknowledged in devhub_ack.json; acknowledged steps stay in the report
+but stop failing `check` (docs/DEVHUB.md has the workflow).
+
+Usage:
+    python tools/devhub.py report
+    python tools/devhub.py check --strict-new
+    python tools/devhub.py html [--out devhub.html]
+
+Exit codes: 0 ok, 1 unacknowledged regression (check), 2 usage/missing
+input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import html as html_mod
+import json
+import math
+import os
+import sys
+from statistics import median
+
+TOOLS = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TOOLS)
+for _p in (REPO, TOOLS):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
+
+import bench_gate  # noqa: E402  (tools/bench_gate.py — the gated-metric registry)
+
+from tigerbeetle_tpu import envprofile  # noqa: E402
+
+# The devhub metric set: every gated metric (single-sourced from
+# bench_gate so the two tools can never disagree), plus the headline
+# device configs (not gated — the ROADMAP bar tracks e2e — but their
+# trajectory is exactly where a host change shows first), plus the
+# exact-gated compile counts (any increase is a regression).
+METRICS = tuple(
+    (f"{s}.{k}", higher) for s, k, higher in bench_gate.GATED
+) + (
+    ("config1_default.posted_per_s", True),
+    ("config2_zipf.posted_per_s", True),
+) + tuple(
+    (f"{s}.{k}", False) for s, k in bench_gate.GATED_EXACT
+)
+
+DEFAULT_DEVHUB = os.path.join(REPO, "devhub.jsonl")
+DEFAULT_ACK = os.path.join(REPO, "devhub_ack.json")
+DEFAULT_HTML = os.path.join(REPO, "devhub.html")
+
+# Detector tuning (docs/DEVHUB.md): MIN_SHIFT is the absolute
+# median-shift floor (2x the bench_gate tolerance — a step must be
+# unambiguous at this host's run noise), NOISE_MULT scales the floor by
+# the series' own pooled MAD, RANK_FRAC is the cross-segment rank
+# consistency a real regime change exhibits, MIN_RIGHT is the
+# runs-of-evidence rule (a regime exists only once 2 runs land in it).
+MIN_POINTS = 5
+MIN_LEFT = 1
+MIN_RIGHT = 2
+MIN_SHIFT = 0.20
+RANK_FRAC = 0.80
+NOISE_MULT = 2.5
+_EPS = 1e-12
+
+
+def _split_stats(values, lo, t, hi):
+    """(shift, rel_mad, rank_consistency, med_l, med_r) for a candidate
+    split of values[lo:hi] at t."""
+    left = values[lo:t]
+    right = values[t:hi]
+    med_l = median(left)
+    med_r = median(right)
+    shift = abs(med_r - med_l) / max(abs(med_l), _EPS)
+    devs = [abs(x - med_l) for x in left] + [abs(x - med_r) for x in right]
+    rel_mad = median(devs) / max(abs(med_l), abs(med_r), _EPS)
+    sign = 1.0 if med_r > med_l else -1.0
+    good = sum(1 for a in left for b in right if (b - a) * sign > 0)
+    total = len(left) * len(right)
+    rank = good / total if total else 0.0
+    return shift, rel_mad, rank, med_l, med_r
+
+
+def _rank_bar(rank_frac, n_left, n_right):
+    """The rank-consistency bar for a split: rank_frac normally, but a
+    minimal-evidence NEW regime (right side under 3 points) must
+    separate PERFECTLY — with 2 points, one severe outlier plus a
+    low-normal neighbor can fake a 20%+ median "regime" that partial
+    rank consistency would wave through. The bar stays rank_frac for a
+    small LEFT side: a long right segment can span later regimes whose
+    spread legitimately overlaps one old point (the r01→r02 shape), and
+    _small_segments_coherent already rejects incoherent small lefts."""
+    return 1.0 if n_right < 3 else rank_frac
+
+
+def _small_segments_coherent(values, lo, t, hi, med_l, med_r):
+    """Internal-coherence guard for minimal-evidence segments: a
+    2-point regime whose own spread rivals the step it claims is one
+    outlier plus a stray neighbor, not a regime (rank separation can't
+    catch it when the stray happens to be the old regime's minimum —
+    but a REAL new regime's two runs agree with each other)."""
+    diff = abs(med_r - med_l)
+    for seg in (values[lo:t], values[t:hi]):
+        if len(seg) >= 3:
+            continue
+        mad = median([abs(x - median(seg)) for x in seg])
+        if mad > 0.5 * diff:
+            return False
+    return True
+
+
+def _best_split(values, lo, hi, min_left, min_right, min_shift, rank_frac,
+                noise_mult):
+    """The qualifying split of values[lo:hi] with the best
+    lowest L1 segmentation cost, or None. Qualification: the median
+    shift clears both the absolute floor and noise_mult x pooled MAD,
+    and cross-segment rank order is consistent (a single outlier
+    cannot fake a regime change). A singleton LEFT segment is only
+    allowed at the very start of the series (the r01→r02 shape);
+    mid-series, the left side is an established regime and one point
+    of it is no evidence — without this rule a lone spike fabricates a
+    one-point regime with a step on each side.
+
+    Boundary placement: among qualifying splits the winner MINIMIZES
+    the L1 cost (sum of absolute deviations from each segment's
+    median). The shift statistic itself cannot place the boundary —
+    medians are so robust that misfiling a few points across the edge
+    barely moves them — while the L1 cost charges every misfiled point
+    its full distance to the wrong regime's median."""
+    best = None
+    eff_min_left = min_left if lo == 0 else max(min_left, 2)
+    for t in range(lo + eff_min_left, hi - min_right + 1):
+        shift, rel_mad, rank, med_l, med_r = _split_stats(values, lo, t, hi)
+        if med_l == med_r:
+            continue
+        if shift < max(min_shift, noise_mult * rel_mad):
+            continue
+        if rank < _rank_bar(rank_frac, t - lo, hi - t):
+            continue
+        if not _small_segments_coherent(values, lo, t, hi, med_l, med_r):
+            continue
+        cost = sum(abs(x - med_l) for x in values[lo:t]) + sum(
+            abs(x - med_r) for x in values[t:hi]
+        )
+        if best is None or cost < best[0]:
+            best = (cost, t)
+    return None if best is None else best[1]
+
+
+def detect_change_points(values, *, min_points=MIN_POINTS, min_left=MIN_LEFT,
+                         min_right=MIN_RIGHT, min_shift=MIN_SHIFT,
+                         rank_frac=RANK_FRAC, noise_mult=NOISE_MULT):
+    """Sorted indices t where values[t] starts a new regime.
+
+    Binary segmentation: find the strongest qualifying split, recurse
+    into both sides. min_left=1 lets the very first run of a history be
+    its own old regime (the r01→r02 case); min_right=2 demands two runs
+    of evidence for the NEW regime, so the latest lone outlier is never
+    a step (it is a `suspect`, see check --strict-new). Series shorter
+    than min_points are never segmented (too little evidence at ±10%
+    run noise)."""
+    n = len(values)
+    if n < min_points:
+        return []
+    out = []
+
+    def seg(lo, hi):
+        if hi - lo < min_left + min_right:
+            return
+        t = _best_split(values, lo, hi, min_left, min_right, min_shift,
+                        rank_frac, noise_mult)
+        if t is None:
+            return
+        out.append(t)
+        seg(lo, t)
+        seg(t, hi)
+
+    seg(0, n)
+    return _refine(values, sorted(out), min_left, min_right, min_shift,
+                   rank_frac, noise_mult)
+
+
+def _refine(values, cps, min_left, min_right, min_shift, rank_frac,
+            noise_mult):
+    """Re-localize + re-qualify the discovered boundaries.
+
+    Discovery scores each split under a TWO-segment model, which is
+    ambiguous while the segment still holds several true boundaries
+    (the global L1 optimum can sit anywhere between two real steps).
+    Between its already-found neighbors, though, each boundary brackets
+    exactly one regime change — so re-placing it there by L1 cost is
+    sharp. After re-localization, any boundary whose split no longer
+    qualifies between its neighbors (shift floor, noise multiple, rank
+    consistency, segment minima) is dropped; iterate until stable."""
+    n = len(values)
+    for _ in range(4):
+        changed = False
+        bounds = [0] + cps + [n]
+        # Re-localize each boundary between its (updating) neighbors.
+        for i in range(1, len(bounds) - 1):
+            lo, hi = bounds[i - 1], bounds[i + 1]
+            eff_left = min_left if lo == 0 else max(min_left, 2)
+            best = None
+            for t in range(lo + eff_left, hi - min_right + 1):
+                med_l = median(values[lo:t])
+                med_r = median(values[t:hi])
+                cost = sum(abs(x - med_l) for x in values[lo:t]) + sum(
+                    abs(x - med_r) for x in values[t:hi]
+                )
+                if best is None or cost < best[0]:
+                    best = (cost, t)
+            if best is not None and best[1] != bounds[i]:
+                bounds[i] = best[1]
+                changed = True
+        cps = sorted(set(bounds[1:-1]))
+        # Re-qualify every boundary in its refined window.
+        bounds = [0] + cps + [n]
+        kept = []
+        for i in range(1, len(bounds) - 1):
+            lo, t, hi = bounds[i - 1], bounds[i], bounds[i + 1]
+            eff_left = min_left if lo == 0 else max(min_left, 2)
+            if t - lo < eff_left or hi - t < min_right:
+                changed = True
+                continue
+            shift, rel_mad, rank, med_l, med_r = _split_stats(
+                values, lo, t, hi
+            )
+            if (med_l == med_r
+                    or shift < max(min_shift, noise_mult * rel_mad)
+                    or rank < _rank_bar(rank_frac, t - lo, hi - t)
+                    or not _small_segments_coherent(
+                        values, lo, t, hi, med_l, med_r)):
+                changed = True
+                continue
+            kept.append(t)
+        cps = kept
+        if not changed:
+            break
+    return cps
+
+
+# --- series over devhub.jsonl -------------------------------------------
+
+
+def load_rows(path):
+    """Every parsable JSON row of a devhub.jsonl; corrupt/truncated
+    lines are counted and skipped, never fatal (backfill tolerance —
+    the file predates every schema field this tool reads)."""
+    rows, bad = [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                bad += 1
+    return rows, bad
+
+
+def bench_rows(rows):
+    """The benchmark rows of the series (bench.py runs — one row per
+    `python bench.py`); gate/profile rows ride the same file but are
+    not trajectory points."""
+    return [
+        r for r in rows
+        if r.get("metric") == "posted_transfers_per_sec"
+        and isinstance(r.get("extra"), dict)
+    ]
+
+
+def group_by_profile(brows):
+    """Ordered {profile_id: [row, ...]}; un-fingerprinted rows adopt
+    the dev-container profile (envprofile.LEGACY_PROFILE)."""
+    groups = {}
+    for r in brows:
+        pid = envprofile.record_profile_id(r)
+        groups.setdefault(pid, []).append(r)
+    return groups
+
+
+def series_points(group, label):
+    """[(row_ordinal, value, git, unix_timestamp)] for one metric over
+    one profile group. Rows missing the key (older schema, partial
+    runs, errored sections) are gaps — skipped, never crashes."""
+    pts = []
+    for ordinal, row in enumerate(group):
+        v = bench_gate.lookup(row["extra"], label)
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        v = float(v)
+        if not math.isfinite(v):
+            continue
+        pts.append((ordinal, v, row.get("git"), row.get("unix_timestamp")))
+    return pts
+
+
+def analyze_series(points, higher_better):
+    """Detected steps + regime stats for one metric series.
+
+    Returns {points, steps, regime_median, current}; each step carries
+    the devhub row ordinal where the new regime starts, the git
+    attribution window (last git of the old regime → first git of the
+    new one), both regime medians, and the regression verdict under the
+    metric's direction."""
+    values = [p[1] for p in points]
+    cps = detect_change_points(values)
+    bounds = [0] + cps + [len(values)]
+    steps = []
+    for i, t in enumerate(cps):
+        seg_lo = bounds[i]
+        seg_hi = bounds[i + 2] if i + 2 < len(bounds) else len(values)
+        before = median(values[seg_lo:t])
+        after = median(values[t:seg_hi])
+        worse = after < before if higher_better else after > before
+        steps.append({
+            "index": points[t][0],
+            "value_index": t,
+            "git_from": points[t - 1][2] if t > 0 else None,
+            "git_to": points[t][2],
+            "before_median": before,
+            "after_median": after,
+            "regression": worse,
+        })
+    regime_lo = cps[-1] if cps else 0
+    regime = values[regime_lo:]
+    return {
+        "points": points,
+        "steps": steps,
+        "regime_median": median(regime) if regime else None,
+        "current": values[-1] if values else None,
+    }
+
+
+def trailing_suspect(points, steps, higher_better):
+    """The newest run when it deviates regression-ward from its regime
+    median past the detector threshold but is not yet a confirmed step
+    (needs a second run of evidence — the --strict-new catcher)."""
+    values = [p[1] for p in points]
+    regime_lo = steps[-1]["value_index"] if steps else 0
+    regime = values[regime_lo:]
+    if len(regime) < 3:
+        return None
+    med = median(regime)
+    devs = [abs(x - med) for x in regime]
+    rel_mad = median(devs) / max(abs(med), _EPS)
+    last = regime[-1]
+    deviation = (last - med) / max(abs(med), _EPS)
+    bad = deviation < 0 if higher_better else deviation > 0
+    if not bad or abs(deviation) < max(MIN_SHIFT, NOISE_MULT * rel_mad):
+        return None
+    return {
+        "index": points[-1][0],
+        "git": points[-1][2],
+        "value": last,
+        "regime_median": med,
+        "deviation_pct": round(100.0 * deviation, 1),
+    }
+
+
+# --- acknowledgements ----------------------------------------------------
+
+
+def load_acks(path):
+    """devhub_ack.json: [{metric, index|git, profile?, reason}]. A
+    missing file means no acknowledgements; a malformed one is a usage
+    error (acks gate CI — they must not fail open silently)."""
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, list):
+        acks = data
+    elif isinstance(data, dict):
+        acks = data.get("acks", [])
+    else:
+        raise ValueError(f"{path}: expected an 'acks' list")
+    if not isinstance(acks, list):
+        raise ValueError(f"{path}: expected an 'acks' list")
+    return [a for a in acks if isinstance(a, dict) and a.get("metric")]
+
+
+def find_ack(acks, metric, profile, index, git):
+    """The acknowledgement covering a step/suspect, or None. Matches on
+    metric + (row index or git of the new regime's first run) +
+    profile ('*' or absent = any profile)."""
+    for a in acks:
+        if a["metric"] != metric:
+            continue
+        ack_profile = a.get("profile", "*")
+        if ack_profile not in ("*", profile):
+            continue
+        if "index" in a and int(a["index"]) == int(index):
+            return a
+        if a.get("git") and git and a["git"] == git:
+            return a
+    return None
+
+
+# --- analysis driver -----------------------------------------------------
+
+
+def analyze(devhub_path, ack_path, profile_filter=None):
+    """Full analysis: per profile, per metric — series, steps (with ack
+    state), trailing suspect (with ack state). The shared driver behind
+    report/check/html."""
+    rows, bad = load_rows(devhub_path)
+    brows = bench_rows(rows)
+    acks = load_acks(ack_path)
+    groups = group_by_profile(brows)
+    out = {
+        "rows": len(rows),
+        "bench_rows": len(brows),
+        "bad_lines": bad,
+        "profiles": [],
+    }
+    for pid, group in groups.items():
+        if profile_filter and pid != profile_filter:
+            continue
+        prof = {"profile_id": pid, "rows": len(group), "metrics": []}
+        for label, higher in METRICS:
+            points = series_points(group, label)
+            if not points:
+                continue
+            a = analyze_series(points, higher)
+            for step in a["steps"]:
+                ack = find_ack(acks, label, pid, step["index"],
+                               step["git_to"])
+                step["ack"] = ack.get("reason") if ack else None
+            suspect = trailing_suspect(points, a["steps"], higher)
+            if suspect is not None:
+                ack = find_ack(acks, label, pid, suspect["index"],
+                               suspect["git"])
+                suspect["ack"] = ack.get("reason") if ack else None
+            prof["metrics"].append({
+                "metric": label,
+                "higher_better": higher,
+                "points": points,
+                "n": len(points),
+                "gaps": len(group) - len(points),
+                "current": a["current"],
+                "regime_median": a["regime_median"],
+                "steps": a["steps"],
+                "suspect": suspect,
+            })
+        out["profiles"].append(prof)
+    return out
+
+
+def _fmt(v):
+    """Human number: thousands-separated past 1000, 2 decimals under."""
+    if v is None:
+        return "—"
+    return f"{v:,.0f}" if abs(v) >= 1000 else f"{v:,.2f}"
+
+
+def _step_text(step):
+    arrow = "↓" if step["before_median"] > step["after_median"] else "↑"
+    git = f"{step['git_from'] or '?'}→{step['git_to'] or '?'}"
+    tag = ""
+    if step["regression"]:
+        tag = " [ACK: " + step["ack"] + "]" if step["ack"] else " [REGRESSION]"
+    return (f"{arrow}@{step['index']} "
+            f"{_fmt(step['before_median'])}→{_fmt(step['after_median'])} "
+            f"(git {git}){tag}")
+
+
+def cmd_report(analysis) -> int:
+    print(
+        f"devhub trajectory — {analysis['bench_rows']} bench rows "
+        f"({analysis['rows']} total, {analysis['bad_lines']} unparsable), "
+        f"{len(analysis['profiles'])} profile(s)"
+    )
+    for prof in analysis["profiles"]:
+        legacy = " (legacy rows adopted)" if (
+            prof["profile_id"] == envprofile.legacy_profile_id()
+        ) else ""
+        print(f"\nprofile {prof['profile_id']}{legacy} — "
+              f"{prof['rows']} run(s)")
+        width = max((len(m["metric"]) for m in prof["metrics"]), default=10)
+        print(f"  {'metric':{width}s} {'n':>3s} {'current':>14s} "
+              f"{'median':>14s}  change-points")
+        for m in prof["metrics"]:
+            steps = "; ".join(_step_text(s) for s in m["steps"]) or "—"
+            if m["suspect"]:
+                s = m["suspect"]
+                ack = f" ACK: {s['ack']}" if s.get("ack") else ""
+                steps += (f"  [suspect @{s['index']} "
+                          f"{s['deviation_pct']:+.1f}% vs regime{ack}]")
+            print(f"  {m['metric']:{width}s} {m['n']:3d} "
+                  f"{_fmt(m['current']):>14s} {_fmt(m['regime_median']):>14s}"
+                  f"  {steps}")
+    return 0
+
+
+def check_failures(analysis, strict_new=False):
+    """The list of failure strings `check` reports: unacknowledged
+    regression steps always; unacknowledged trailing suspects only
+    under --strict-new (one run of evidence is advisory)."""
+    failures = []
+    for prof in analysis["profiles"]:
+        for m in prof["metrics"]:
+            for step in m["steps"]:
+                if step["regression"] and not step["ack"]:
+                    failures.append(
+                        f"{m['metric']} [{prof['profile_id']}]: regression "
+                        f"step at row {step['index']} "
+                        f"(git {step['git_from'] or '?'}→"
+                        f"{step['git_to'] or '?'}): "
+                        f"{_fmt(step['before_median'])} → "
+                        f"{_fmt(step['after_median'])}"
+                    )
+            s = m["suspect"]
+            if strict_new and s and not s.get("ack"):
+                failures.append(
+                    f"{m['metric']} [{prof['profile_id']}]: SUSPECT — newest "
+                    f"run (row {s['index']}, git {s['git'] or '?'}) is "
+                    f"{s['deviation_pct']:+.1f}% vs its regime median "
+                    f"{_fmt(s['regime_median'])}; a second run confirms or "
+                    "clears it"
+                )
+    return failures
+
+
+def cmd_check(analysis, strict_new) -> int:
+    failures = check_failures(analysis, strict_new)
+    n_steps = sum(
+        len(m["steps"]) for p in analysis["profiles"] for m in p["metrics"]
+    )
+    if failures:
+        print(f"devhub check: FAIL — {len(failures)} unacknowledged "
+              "regression(s):")
+        for f in failures:
+            print(f"  {f}")
+        print("acknowledge intentional steps in devhub_ack.json "
+              "(docs/DEVHUB.md) or fix the regression")
+        return 1
+    print(f"devhub check: PASS ({n_steps} change-point(s) across "
+          f"{len(analysis['profiles'])} profile(s), all regressions "
+          "acknowledged)")
+    return 0
+
+
+# --- html dashboard ------------------------------------------------------
+
+_CSS = """
+:root { color-scheme: light dark; }
+body {
+  margin: 0; padding: 24px;
+  font: 13px/1.45 system-ui, -apple-system, sans-serif;
+  background: var(--surface-1); color: var(--text-primary);
+  --surface-1: #fcfcfb; --surface-2: #f0efec;
+  --text-primary: #0b0b0b; --text-secondary: #52514e;
+  --series-1: #2a78d6; --good: #008300; --serious: #e34948;
+  --grid: #e3e2de;
+}
+@media (prefers-color-scheme: dark) {
+  body {
+    --surface-1: #1a1a19; --surface-2: #383835;
+    --text-primary: #ffffff; --text-secondary: #c3c2b7;
+    --series-1: #3987e5; --good: #4fbb4f; --serious: #e66767;
+    --grid: #33332f;
+  }
+}
+h1 { font-size: 18px; margin: 0 0 4px; }
+h2 { font-size: 14px; margin: 28px 0 8px; color: var(--text-secondary);
+     font-weight: 600; }
+.sub { color: var(--text-secondary); margin-bottom: 20px; }
+.card { max-width: 760px; padding: 12px 16px; margin-bottom: 12px;
+        border: 1px solid var(--grid); border-radius: 8px; }
+.card h3 { font-size: 13px; margin: 0 0 2px; font-weight: 600; }
+.stats { color: var(--text-secondary); margin-bottom: 6px; }
+.stats b { color: var(--text-primary); font-weight: 600; }
+.step-note { color: var(--text-secondary); }
+.step-note .reg { color: var(--serious); font-weight: 600; }
+.step-note .imp { color: var(--good); font-weight: 600; }
+svg { display: block; }
+details { margin-top: 6px; color: var(--text-secondary); }
+table { border-collapse: collapse; margin-top: 6px; }
+td, th { padding: 2px 10px 2px 0; text-align: right;
+         border-bottom: 1px solid var(--grid); }
+th { color: var(--text-secondary); font-weight: 600; }
+td:first-child, th:first-child { text-align: left; }
+"""
+
+
+def _svg_sparkline(metric, points, group_len, steps, suspect):
+    """One annotated sparkline: the metric's trajectory as a 2px
+    polyline (series-1 blue, the single series needs no legend — the
+    card title names it), gaps break the line, every point carries a
+    native-tooltip hover target, change-points get a dashed marker line
+    plus an icon+text annotation (never color alone)."""
+    W, H, PAD_X, PAD_TOP, PAD_BOT = 720, 96, 8, 26, 10
+    values = [p[1] for p in points]
+    vmin, vmax = min(values), max(values)
+    span = (vmax - vmin) or max(abs(vmax), 1.0)
+    vmin -= span * 0.08
+    vmax += span * 0.08
+
+    def x(ordinal):
+        if group_len <= 1:
+            return W / 2
+        return PAD_X + (W - 2 * PAD_X) * ordinal / (group_len - 1)
+
+    def y(v):
+        return PAD_TOP + (H - PAD_TOP - PAD_BOT) * (
+            1.0 - (v - vmin) / (vmax - vmin)
+        )
+
+    parts = [f'<svg viewBox="0 0 {W} {H}" width="{W}" height="{H}" '
+             f'role="img" aria-label="{html_mod.escape(metric)} trajectory">']
+    # Baseline grid (recessive).
+    parts.append(
+        f'<line x1="{PAD_X}" y1="{H - PAD_BOT}" x2="{W - PAD_X}" '
+        f'y2="{H - PAD_BOT}" stroke="var(--grid)" stroke-width="1"/>'
+    )
+    # Change-point markers behind the line.
+    step_by_vi = {s["value_index"]: s for s in steps}
+    for s in steps:
+        cx = x(points[s["value_index"]][0])
+        color = "var(--serious)" if s["regression"] else "var(--good)"
+        parts.append(
+            f'<line x1="{cx:.1f}" y1="{PAD_TOP - 12}" x2="{cx:.1f}" '
+            f'y2="{H - PAD_BOT}" stroke="{color}" stroke-width="1" '
+            'stroke-dasharray="3 3"/>'
+        )
+        arrow = "▼" if s["before_median"] > s["after_median"] else "▲"
+        tag = "ack" if s.get("ack") else ("reg" if s["regression"] else "imp")
+        anchor = "end" if cx > W - 120 else "start"
+        dx = -4 if anchor == "end" else 4
+        git_label = html_mod.escape(s["git_to"] or "run %d" % s["index"])
+        parts.append(
+            f'<text x="{cx + dx:.1f}" y="{PAD_TOP - 14}" font-size="10" '
+            f'text-anchor="{anchor}" fill="{color}">{arrow} '
+            f'{git_label} {tag}</text>'
+        )
+    # Polyline segments: a gap (missing row) breaks the line.
+    seg = []
+    prev_ord = None
+    segs = []
+    for p in points:
+        if prev_ord is not None and p[0] != prev_ord + 1:
+            segs.append(seg)
+            seg = []
+        seg.append(p)
+        prev_ord = p[0]
+    segs.append(seg)
+    for seg in segs:
+        if len(seg) == 1:
+            continue
+        pts = " ".join(f"{x(o):.1f},{y(v):.1f}" for o, v, _, _ in seg)
+        parts.append(
+            f'<polyline points="{pts}" fill="none" stroke="var(--series-1)" '
+            'stroke-width="2" stroke-linejoin="round" '
+            'stroke-linecap="round"/>'
+        )
+    # Points: visible dot + oversized transparent hover target with a
+    # native tooltip (row, git, value).
+    for vi, (o, v, git, ts) in enumerate(points):
+        cx, cy = x(o), y(v)
+        in_step = vi in step_by_vi
+        r = 3.5 if in_step else 2.2
+        fill = "var(--series-1)"
+        if in_step:
+            fill = ("var(--serious)" if step_by_vi[vi]["regression"]
+                    else "var(--good)")
+        tip = html_mod.escape(
+            f"run {o} · git {git or '?'} · {_fmt(v)}"
+        )
+        parts.append(
+            f'<circle cx="{cx:.1f}" cy="{cy:.1f}" r="{r}" fill="{fill}" '
+            f'stroke="var(--surface-1)" stroke-width="1">'
+            f'<title>{tip}</title></circle>'
+        )
+        parts.append(
+            f'<circle cx="{cx:.1f}" cy="{cy:.1f}" r="8" fill="transparent">'
+            f'<title>{tip}</title></circle>'
+        )
+    if suspect:
+        cx = x(suspect["index"])
+        parts.append(
+            f'<text x="{cx - 4:.1f}" y="{H - PAD_BOT + 9}" font-size="10" '
+            'text-anchor="end" fill="var(--serious)">? suspect</text>'
+        )
+    parts.append("</svg>")
+    return "".join(parts)
+
+
+def cmd_html(analysis, out_path) -> int:
+    """Render the dashboard (devhub.js analog): per profile, one card
+    per metric — sparkline, current/median stats, change-point notes,
+    and a <details> table view of the raw series."""
+    doc = [
+        "<!doctype html><html lang=\"en\"><head><meta charset=\"utf-8\">",
+        "<meta name=\"viewport\" content=\"width=device-width\">",
+        "<title>tigerbeetle-tpu devhub</title>",
+        f"<style>{_CSS}</style></head><body>",
+        "<h1>tigerbeetle-tpu devhub</h1>",
+        f"<div class=\"sub\">{analysis['bench_rows']} benchmark runs · "
+        f"{len(analysis['profiles'])} environment profile(s) · "
+        "change-points by rank/median step detection "
+        "(docs/DEVHUB.md)</div>",
+    ]
+    for prof in analysis["profiles"]:
+        legacy = " · legacy rows adopted" if (
+            prof["profile_id"] == envprofile.legacy_profile_id()
+        ) else ""
+        doc.append(
+            f"<h2>profile {prof['profile_id']}{legacy} · "
+            f"{prof['rows']} runs</h2>"
+        )
+        for m in prof["metrics"]:
+            doc.append('<div class="card">')
+            doc.append(f"<h3>{html_mod.escape(m['metric'])}</h3>")
+            direction = "higher is better" if m["higher_better"] \
+                else "lower is better"
+            doc.append(
+                f'<div class="stats">current <b>{_fmt(m["current"])}</b> '
+                f'· regime median <b>{_fmt(m["regime_median"])}</b> '
+                f'· {m["n"]} runs'
+                + (f' · {m["gaps"]} gaps' if m["gaps"] else "")
+                + f' · {direction}</div>'
+            )
+            doc.append(_svg_sparkline(
+                m["metric"], m["points"], prof["rows"], m["steps"],
+                m["suspect"],
+            ))
+            notes = []
+            for s in m["steps"]:
+                # Class/label follow the step DIRECTION (matching the
+                # red/green sparkline marker); an ack annotates, it
+                # never flips a regression green.
+                cls = "reg" if s["regression"] else "imp"
+                label = "regression" if s["regression"] else "improvement"
+                if s["ack"]:
+                    label += (" (acknowledged: "
+                              + html_mod.escape(s["ack"]) + ")")
+                notes.append(
+                    f'<span class="{cls}">{html_mod.escape(_step_text(s))}'
+                    f'</span> — {label}'
+                )
+            s = m["suspect"]
+            if s:
+                notes.append(
+                    f'<span class="reg">suspect @{s["index"]} '
+                    f'{s["deviation_pct"]:+.1f}%</span> — newest run '
+                    "deviates; a second run confirms or clears it"
+                    + (f' (acknowledged: {html_mod.escape(s["ack"])})'
+                       if s.get("ack") else "")
+                )
+            if notes:
+                doc.append('<div class="step-note">'
+                           + "<br>".join(notes) + "</div>")
+            # Table view (the accessibility fallback — identity and
+            # values never live in color alone).
+            rows_html = "".join(
+                f"<tr><td>{o}</td><td>{html_mod.escape(git or '?')}</td>"
+                f"<td>{_fmt(v)}</td></tr>"
+                for o, v, git, _ in m["points"]
+            )
+            doc.append(
+                "<details><summary>table view</summary><table>"
+                "<tr><th>run</th><th>git</th><th>value</th></tr>"
+                f"{rows_html}</table></details>"
+            )
+            doc.append("</div>")
+    doc.append("</body></html>")
+    with open(out_path, "w") as f:
+        f.write("".join(doc))
+    print(f"devhub html: wrote {out_path}")
+    return 0
+
+
+# --- entry ---------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="devhub", description=__doc__.splitlines()[0])
+    p.add_argument("command", choices=("report", "check", "html"))
+    p.add_argument("--devhub", default=DEFAULT_DEVHUB,
+                   help="series file (default: repo devhub.jsonl)")
+    p.add_argument("--ack", default=DEFAULT_ACK,
+                   help="acknowledgement file (default: repo devhub_ack.json)")
+    p.add_argument("--profile", default=None,
+                   help="restrict to one profile_id (default: all)")
+    p.add_argument("--strict-new", action="store_true",
+                   help="check: also fail on an unacknowledged trailing "
+                        "suspect (newest run deviating regression-ward "
+                        "before a second run confirms it)")
+    p.add_argument("--out", default=DEFAULT_HTML,
+                   help="html: output path (default: repo devhub.html)")
+    args = p.parse_args(argv)
+
+    if not os.path.exists(args.devhub):
+        print(f"devhub: no series file at {args.devhub} — run bench.py "
+              "(or bench_gate) to start one", file=sys.stderr)
+        return 2
+    try:
+        analysis = analyze(args.devhub, args.ack, args.profile)
+    except (OSError, ValueError) as e:
+        print(f"devhub: {e}", file=sys.stderr)
+        return 2
+    if args.profile and not analysis["profiles"]:
+        # Fail closed, not green: a typo'd/rotated profile id silently
+        # analyzing zero series would let `check` pass forever.
+        print(f"devhub: no rows match profile {args.profile} (known "
+              "profiles appear in `report` without --profile)",
+              file=sys.stderr)
+        return 2
+
+    if args.command == "report":
+        return cmd_report(analysis)
+    if args.command == "check":
+        return cmd_check(analysis, args.strict_new)
+    return cmd_html(analysis, args.out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
