@@ -1,0 +1,260 @@
+/* busio: the native front-door datapath (docs/NATIVE_DATAPATH.md).
+ *
+ * The reference runs its message bus as fixed-pool, zero-alloc,
+ * checksummed frames on io_uring (message_bus.zig / message_pool.zig /
+ * io/linux.zig). This shim moves the per-frame byte work of the TPU
+ * build's asyncio bus into C, one GIL-releasing call per *batch*:
+ *
+ *   busio_scan             parse + AEGIS-verify every complete frame in a
+ *                          receive buffer, emitting SoA routing columns
+ *                          (offset/size/command/client/request/replica/op)
+ *   busio_encode_frame     fill + double-MAC a 256-byte header for an
+ *                          outbound frame (replies, BUSY sheds, requests)
+ *   busio_decode_transfers wire AoS transfer records -> the device
+ *                          kernel's preallocated SoA limb columns
+ *   busio_pwritev          a batch of positioned writes (the WAL
+ *                          header-ring + body segments) in one call
+ *
+ * Wire layout is vsr/header.HEADER_DTYPE (256 bytes, little-endian);
+ * offsets here are asserted against the numpy dtype by the golden-vector
+ * probe in tools/check.py and tests/test_native_bus.py — drift fails CI.
+ *
+ * Build: cc -O3 -maes -mssse3 -shared -fPIC busio.c -o libbusio.so
+ */
+
+#include <errno.h>
+#include <stdint.h>
+#include <string.h>
+#include <unistd.h>
+
+/* One compilation unit with the checksum: busio frames are sealed with
+ * the same AEGIS-128L MAC as every header/body/grid block. */
+#include "aegis128l.c"
+
+#define HEADER_SIZE 256u
+#define CHECKSUM_SIZE 16u
+#define FRAME_SIZE_MAX (1u << 21) /* bus.ReplicaServer.STREAM_LIMIT */
+
+/* HEADER_DTYPE field offsets (little-endian). */
+#define OFF_CHECKSUM 0
+#define OFF_CHECKSUM_BODY 16
+#define OFF_PARENT 32
+#define OFF_CLIENT 48
+#define OFF_CLUSTER 64
+#define OFF_SIZE 80
+#define OFF_EPOCH 84
+#define OFF_VIEW 88
+#define OFF_RELEASE 92
+#define OFF_OP 96
+#define OFF_COMMIT 104
+#define OFF_TIMESTAMP 112
+#define OFF_REQUEST 120
+#define OFF_REPLICA 124
+#define OFF_COMMAND 125
+#define OFF_OPERATION 126
+#define OFF_VERSION 127
+
+static inline uint32_t rd32(const uint8_t *p) {
+    uint32_t v;
+    memcpy(&v, p, 4);
+    return v;
+}
+
+static inline uint32_t rd16(const uint8_t *p) {
+    uint16_t v;
+    memcpy(&v, p, 2);
+    return v;
+}
+
+static inline uint64_t rd64(const uint8_t *p) {
+    uint64_t v;
+    memcpy(&v, p, 8);
+    return v;
+}
+
+static inline void wr32(uint8_t *p, uint32_t v) { memcpy(p, &v, 4); }
+static inline void wr64(uint8_t *p, uint64_t v) { memcpy(p, &v, 8); }
+
+/* --- scan ---------------------------------------------------------------
+ *
+ * Parse every complete frame in buf[0..len): header MAC, size bounds,
+ * body MAC — all verified here, so Python never re-MACs an inbound frame.
+ * Per valid frame, 8 SoA columns are written to out (row-major, stride
+ * BUSIO_SCAN_COLS): offset, size, command, client_lo, client_hi, request,
+ * replica, operation.
+ *
+ * tail[0] = consumed bytes (start of the first incomplete/invalid frame)
+ * tail[1] = total buffer length needed for the next frame to complete
+ *           (consumed + HEADER_SIZE until its header arrived, then
+ *           consumed + size) — the reader's read-ahead hint
+ * tail[2] = status: 0 ok/need-more, 1 header MAC fail, 2 size invalid,
+ *           3 body MAC fail (frames before the failure are still emitted)
+ *
+ * Returns the number of frames written (stops at max_frames; the caller
+ * re-scans the remainder).
+ */
+#define BUSIO_SCAN_COLS 8
+
+int64_t busio_scan(const uint8_t *buf, uint64_t len, uint64_t *out,
+                   int64_t max_frames, uint64_t *tail) {
+    uint64_t off = 0;
+    int64_t n = 0;
+    uint64_t status = 0;
+    uint64_t need = HEADER_SIZE;
+    uint8_t tag[16];
+    while (n < max_frames) {
+        if (len - off < HEADER_SIZE) {
+            need = off + HEADER_SIZE;
+            break;
+        }
+        const uint8_t *h = buf + off;
+        aegis128l_mac(h + CHECKSUM_SIZE, HEADER_SIZE - CHECKSUM_SIZE, tag);
+        if (memcmp(tag, h + OFF_CHECKSUM, 16) != 0) {
+            status = 1;
+            need = off + HEADER_SIZE;
+            break;
+        }
+        uint64_t size = rd32(h + OFF_SIZE);
+        if (size < HEADER_SIZE || size > FRAME_SIZE_MAX) {
+            status = 2;
+            need = off + HEADER_SIZE;
+            break;
+        }
+        if (len - off < size) {
+            need = off + size;
+            break;
+        }
+        aegis128l_mac(h + HEADER_SIZE, size - HEADER_SIZE, tag);
+        if (memcmp(tag, h + OFF_CHECKSUM_BODY, 16) != 0) {
+            status = 3;
+            need = off + size;
+            break;
+        }
+        uint64_t *row = out + n * BUSIO_SCAN_COLS;
+        row[0] = off;
+        row[1] = size;
+        row[2] = h[OFF_COMMAND];
+        row[3] = rd64(h + OFF_CLIENT);
+        row[4] = rd64(h + OFF_CLIENT + 8);
+        row[5] = rd32(h + OFF_REQUEST);
+        row[6] = h[OFF_REPLICA];
+        row[7] = h[OFF_OPERATION];
+        off += size;
+        need = off + HEADER_SIZE;
+        n++;
+    }
+    tail[0] = off;
+    tail[1] = need;
+    tail[2] = status;
+    return n;
+}
+
+/* --- encode -------------------------------------------------------------
+ *
+ * Fill a zeroed 256-byte header for an outbound frame and seal it: body
+ * MAC into checksum_body, then the header MAC over bytes [16, 256). The
+ * scratch (hdr_out) is caller-owned — the zero-alloc ReplyBuilder hands
+ * its preallocated record; byte-identical to hdr.make + Message.seal.
+ *
+ * Field values arrive as ONE packed u64[14] block (p, layout below):
+ * ctypes marshals one pointer instead of 17 scalars, which halves the
+ * per-frame call cost on the reply hot path (Python packs it with a
+ * single struct.pack).
+ *
+ *   p[0]=command  p[1]=operation p[2]=view      p[3]=op
+ *   p[4]=commit   p[5]=timestamp p[6]=request   p[7]=replica
+ *   p[8..9]=cluster lo/hi  p[10..11]=client lo/hi  p[12..13]=parent lo/hi
+ */
+void busio_encode_frame(uint8_t *hdr_out, const uint8_t *body,
+                        uint64_t body_len, const uint64_t *p) {
+    memset(hdr_out, 0, HEADER_SIZE);
+    wr64(hdr_out + OFF_PARENT, p[12]);
+    wr64(hdr_out + OFF_PARENT + 8, p[13]);
+    wr64(hdr_out + OFF_CLIENT, p[10]);
+    wr64(hdr_out + OFF_CLIENT + 8, p[11]);
+    wr64(hdr_out + OFF_CLUSTER, p[8]);
+    wr64(hdr_out + OFF_CLUSTER + 8, p[9]);
+    wr32(hdr_out + OFF_SIZE, (uint32_t)(HEADER_SIZE + body_len));
+    wr32(hdr_out + OFF_VIEW, (uint32_t)p[2]);
+    wr64(hdr_out + OFF_OP, p[3]);
+    wr64(hdr_out + OFF_COMMIT, p[4]);
+    wr64(hdr_out + OFF_TIMESTAMP, p[5]);
+    wr32(hdr_out + OFF_REQUEST, (uint32_t)p[6]);
+    hdr_out[OFF_REPLICA] = (uint8_t)p[7];
+    hdr_out[OFF_COMMAND] = (uint8_t)p[0];
+    hdr_out[OFF_OPERATION] = (uint8_t)p[1];
+    hdr_out[OFF_VERSION] = 1;
+    aegis128l_mac(body, body_len, hdr_out + OFF_CHECKSUM_BODY);
+    aegis128l_mac(hdr_out + CHECKSUM_SIZE, HEADER_SIZE - CHECKSUM_SIZE,
+                  hdr_out + OFF_CHECKSUM);
+}
+
+/* --- transfer decode ----------------------------------------------------
+ *
+ * Wire AoS TRANSFER_DTYPE records (128 B each, offsets below) -> the
+ * device kernel's preallocated SoA columns in one pass: u128 fields as
+ * (n,4) u32 limbs, timestamps as (n,2) limbs derived from ts_base + i,
+ * narrow fields widened to u32, account slots narrowed from the staged
+ * i64 lookups to the kernel's i32. Rows [0, n) only — the caller owns
+ * bucket padding. Little-endian limbs are the u64 bytes verbatim, so
+ * every copy is a memcpy.
+ */
+#define T_ID 0
+#define T_DEBIT 16
+#define T_CREDIT 32
+#define T_AMOUNT 48
+#define T_PENDING 64
+#define T_TIMEOUT 108
+#define T_LEDGER 112
+#define T_CODE 116
+#define T_FLAGS 118
+
+void busio_decode_transfers(const uint8_t *events, int64_t n, int64_t stride,
+                            uint64_t ts_base, const int64_t *dr_in,
+                            const int64_t *cr_in, uint32_t *id_limbs,
+                            uint32_t *amount_limbs, uint32_t *pending_limbs,
+                            int32_t *dr_slot, int32_t *cr_slot,
+                            uint32_t *timeout, uint32_t *ledger,
+                            uint32_t *code, uint32_t *flags,
+                            uint32_t *ts_limbs) {
+    for (int64_t i = 0; i < n; i++) {
+        const uint8_t *e = events + i * stride;
+        memcpy(id_limbs + 4 * i, e + T_ID, 16);
+        memcpy(amount_limbs + 4 * i, e + T_AMOUNT, 16);
+        memcpy(pending_limbs + 4 * i, e + T_PENDING, 16);
+        dr_slot[i] = (int32_t)dr_in[i];
+        cr_slot[i] = (int32_t)cr_in[i];
+        timeout[i] = rd32(e + T_TIMEOUT);
+        ledger[i] = rd32(e + T_LEDGER);
+        code[i] = rd16(e + T_CODE);
+        flags[i] = rd16(e + T_FLAGS);
+        uint64_t ts = ts_base + (uint64_t)i;
+        memcpy(ts_limbs + 2 * i, &ts, 8);
+    }
+}
+
+/* --- WAL ring writes ----------------------------------------------------
+ *
+ * A batch of positioned writes — the journal slot's redundant-header-ring
+ * and prepare-body segments — in one GIL-releasing call on the WalWriter
+ * thread. Returns 0, or -errno from the first failed write.
+ */
+int64_t busio_pwritev(int32_t fd, int64_t n, const uint8_t **bufs,
+                      const uint64_t *lens, const uint64_t *offsets) {
+    for (int64_t i = 0; i < n; i++) {
+        const uint8_t *p = bufs[i];
+        uint64_t remaining = lens[i];
+        uint64_t off = offsets[i];
+        while (remaining) {
+            ssize_t w = pwrite(fd, p, remaining, (off_t)off);
+            if (w < 0) {
+                if (errno == EINTR) continue;
+                return -(int64_t)errno;
+            }
+            p += w;
+            off += (uint64_t)w;
+            remaining -= (uint64_t)w;
+        }
+    }
+    return 0;
+}

@@ -169,6 +169,17 @@ def main(backend="numpy", batches=40, overlap=True, store_async=True,
     msgs = [request(Operation.CREATE_TRANSFERS, b) for b in bodies]
     seal_s = time.perf_counter() - t0
 
+    # Native-datapath ingress (docs/NATIVE_DATAPATH.md): when the codec
+    # is enabled, the feed loop re-parses each message from its wire
+    # bytes through the C scanner — exactly the server bus's ingress —
+    # so the stage table's parse row (and the nested bus.scan/bus.decode
+    # sub-spans) attribute the real codec cost. Pre-serialized here
+    # (client-side cost, like marshal/seal above).
+    from tigerbeetle_tpu.net import codec
+
+    bus_scanner = codec.scanner()
+    frames = [m.to_bytes() for m in msgs] if bus_scanner is not None else None
+
     # Warmup: compile every kernel bucket outside the measured window.
     # The store stage is DRAINED before the compile baseline is snapped:
     # its work trails the replies by up to a full queue, so a warmup
@@ -187,13 +198,15 @@ def main(backend="numpy", batches=40, overlap=True, store_async=True,
         replica.store_executor.drain()
         pump()
     msgs = msgs[warmup:]
+    if frames is not None:
+        frames = frames[warmup:]
     compile_snap = compile_registry.snapshot()
 
     tracer.reset()  # measure only the transfer load (all threads re-arm)
     n0 = len(bus.replies)
     wall0 = time.perf_counter()
     with tracer.span("server.total"):
-        for m in msgs:
+        for mi, m in enumerate(msgs):
             # Feed with pipeline backpressure: past pipeline_max the
             # round-14 front door sheds with BUSY (one backlog slot per
             # session), and a shed batch would silently vanish from the
@@ -206,10 +219,20 @@ def main(backend="numpy", batches=40, overlap=True, store_async=True,
             ):
                 pump()
                 time.sleep(0.0002)
-            # Ingress verification runs here exactly as bus.read_message
-            # does on the server, so the stage table attributes it too.
+            # Ingress runs here exactly as the server bus does — the C
+            # scan+decode on the native datapath (zero-copy body off the
+            # frame buffer, verified flag set), the Python body MAC on
+            # the fallback — so the stage table attributes it too.
             with tracer.span("stage.parse"):
-                assert m.header.valid_checksum_body(m.body)
+                if bus_scanner is not None:
+                    raw = frames[mi]
+                    with tracer.span("bus.scan"):
+                        rows, _consumed, _need, status = bus_scanner.scan(raw)
+                    assert status == codec.STATUS_OK and len(rows) == 1
+                    with tracer.span("bus.decode"):
+                        m = codec.messages_from_scan(raw, rows)[0]
+                else:
+                    assert m.header.valid_checksum_body(m.body)
             replica.on_message(m)
             pump()
         settle(n0 + batches)
@@ -365,6 +388,27 @@ def main(backend="numpy", batches=40, overlap=True, store_async=True,
             record[stage] = round(ms / batches, 3)
             print(f"  {stage:14s} {ms / batches:9.2f} {p50:9.1f} {p99:9.1f}")
 
+    # Native bus codec sub-spans (docs/NATIVE_DATAPATH.md): scan+decode
+    # nest inside the parse row, encode inside the reply row — their own
+    # table, never added to the disjoint stage attribution above. This
+    # is the exact before/after attribution for the C-datapath swap.
+    bus_rows = {
+        "bus.scan": ("bus.scan",),
+        "bus.decode": ("bus.decode",),
+        "bus.encode": ("bus.encode",),
+    }
+    if any(span_ms(keys) for keys in bus_rows.values()):
+        print("\nnative bus codec (nested inside parse/reply rows; "
+              "TIGERBEETLE_TPU_NATIVE_BUS governs):")
+        print(f"  {'span':14s} {'ms/batch':>9s} {'p50_us':>9s} {'p99_us':>9s}")
+        for stage, keys in bus_rows.items():
+            ms = span_ms(keys)
+            if not ms:
+                continue
+            p50, p99 = span_pcts(keys)
+            record[stage] = round(ms / batches, 3)
+            print(f"  {stage:14s} {ms / batches:9.2f} {p50:9.1f} {p99:9.1f}")
+
     if overlap or store_async:
         print("\nworker threads (off the commit path; overlaps the wall "
               "time above):")
@@ -497,6 +541,7 @@ def main(backend="numpy", batches=40, overlap=True, store_async=True,
             "extra": {
                 "backend": backend, "batches": batches,
                 "overlap": overlap, "store_async": store_async,
+                "native_bus": int(bus_scanner is not None),
                 "stages": record,
                 "lifecycle": lifecycle["flat"],
             },

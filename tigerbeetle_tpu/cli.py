@@ -359,9 +359,15 @@ def _emit_bench_json(result: dict, args) -> None:
     import json
 
     from tigerbeetle_tpu.envprofile import fingerprint
+    from tigerbeetle_tpu.net import codec
 
     result["backend"] = args.backend
     result["env"] = fingerprint()
+    # Which wire datapath served this run (docs/NATIVE_DATAPATH.md): the
+    # spawned server inherits this process's environment/toolchain, so
+    # the driver's probe answers for both. Devhub change-point
+    # attribution uses it to tell codec steps from host noise.
+    result["native_bus"] = int(codec.enabled())
     print("BENCH_JSON " + json.dumps(result), flush=True)
 
 

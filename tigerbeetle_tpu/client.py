@@ -481,13 +481,20 @@ class AsyncClient:
         return writer
 
     async def _read_loop(self, r: int, reader: asyncio.StreamReader) -> None:
-        from tigerbeetle_tpu.net.bus import read_message
+        from tigerbeetle_tpu.net.bus import frame_source
 
+        source = frame_source(reader)
+        batch: list = []
+        ix = 0
         while True:
-            msg = await read_message(reader)
-            if msg is None:
-                self._writers.pop(r, None)
-                return
+            if ix >= len(batch):
+                nxt = await source.next_batch()
+                if nxt is None:
+                    self._writers.pop(r, None)
+                    return
+                batch, ix = nxt, 0
+            msg = batch[ix]
+            ix += 1
             h = msg.header
             cmd = h["command"]
             if cmd == Command.PONG_CLIENT:
@@ -560,11 +567,13 @@ class AsyncClient:
 
     async def _request(self, sess: dict, operation: int, body) -> Message:
         sess["request"] += 1
-        req = hdr.make(
-            Command.REQUEST, self.cluster,
-            client=sess["client"], request=sess["request"], operation=operation,
+        # make_sealed: one C call on the native datapath (fields + both
+        # MACs, straight over the numpy batch memory), make+seal else.
+        msg = hdr.make_sealed(
+            Command.REQUEST, self.cluster, body=body,
+            client=sess["client"], request=sess["request"],
+            operation=operation,
         )
-        msg = Message(req, body).seal()
         loop = asyncio.get_running_loop()
         deadline_rotations = 4 * len(self.addresses) + 4
         t0 = time.perf_counter()

@@ -178,6 +178,13 @@ class MemStorage:
         with self._lock:
             self._unsynced[offset] = bytes(data)
 
+    def write_batch(self, segments) -> None:
+        """Buffered writes of [(offset, data), ...] (FileStorage routes
+        these through one native pwritev call; here they are just the
+        same buffered writes)."""
+        for offset, data in segments:
+            self.write(offset, data)
+
     def write_durable(self, offset: int, chunks: Sequence[bytes]) -> None:
         """Durable-at-return write (the O_DIRECT|O_DSYNC model): lands in
         the synced image immediately, never pending in the crash model."""
@@ -309,6 +316,25 @@ class FileStorage:
                 os.pwrite(self._fd, data, offset)
             return
         os.pwrite(self._fd, data, offset)
+
+    def write_batch(self, segments) -> None:
+        """Buffered positioned writes of [(offset, data), ...] in ONE
+        GIL-releasing native call when the busio shim is available
+        (csrc/busio.c busio_pwritev — the WAL writer thread's header-ring
+        + body segments, docs/NATIVE_DATAPATH.md), else a pwrite loop.
+        Fault injection always takes the per-write path: pre-image
+        capture must stay atomic with each write."""
+        if self._fi:
+            for offset, data in segments:
+                self.write(offset, data)
+            return
+        from tigerbeetle_tpu.net import codec
+
+        if codec.enabled():  # one switch: TIGERBEETLE_TPU_NATIVE_BUS
+            codec.pwritev(self._fd, list(segments))
+            return
+        for offset, data in segments:
+            os.pwrite(self._fd, data, offset)
 
     def write_durable(self, offset: int, chunks: Sequence[bytes]) -> None:
         """Write `chunks` contiguously at `offset`, durable at return.

@@ -218,6 +218,59 @@ def aegis128l_mac() -> Optional[Callable[[bytes], bytes]]:
     return _mac
 
 
+_busio: Optional[ctypes.CDLL] = None
+_busio_tried = False
+
+
+def busio() -> Optional[ctypes.CDLL]:
+    """The framed-codec + WAL-ring shim (csrc/busio.c — scan, encode,
+    transfer SoA decode, batched pwrite; docs/NATIVE_DATAPATH.md). Frames
+    are sealed with AEGIS-128L, so the shim requires AES-NI like the
+    checksum it verifies; hosts without it keep the pure-Python bus."""
+    global _busio, _busio_tried
+    if _busio_tried:
+        return _busio
+    _busio_tried = True
+    if not _cpu_has_aes():
+        return None
+    src = os.path.join(_CSRC, "busio.c")
+    lib_path = os.path.join(_CSRC, "libbusio.so")
+    if not os.path.exists(src) or not _build_lib(
+        src, lib_path, extra_flags=("-maes", "-mssse3")
+    ):
+        return None
+    try:
+        lib = ctypes.CDLL(lib_path)
+    except OSError:
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u8pp = ctypes.POINTER(ctypes.c_char_p)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.busio_scan.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, u64p, ctypes.c_int64, u64p,
+    ]
+    lib.busio_scan.restype = ctypes.c_int64
+    lib.busio_encode_frame.argtypes = [
+        u8p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+    ]
+    lib.busio_encode_frame.restype = None
+    lib.busio_decode_transfers.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64,
+        i64p, i64p, u32p, u32p, u32p, i32p, i32p,
+        u32p, u32p, u32p, u32p, u32p,
+    ]
+    lib.busio_decode_transfers.restype = None
+    lib.busio_pwritev.argtypes = [
+        ctypes.c_int32, ctypes.c_int64, u8pp, u64p, u64p,
+    ]
+    lib.busio_pwritev.restype = ctypes.c_int64
+    _busio = lib
+    return _busio
+
+
 _tbclient: Optional[ctypes.CDLL] = None
 _tbclient_tried = False
 

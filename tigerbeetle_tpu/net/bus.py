@@ -18,8 +18,10 @@ import traceback
 from typing import Dict, List, Optional, Tuple
 
 from tigerbeetle_tpu import tracer
+from tigerbeetle_tpu.net import codec
 from tigerbeetle_tpu.tidy import runtime as tidy_runtime
 from tigerbeetle_tpu.vsr.header import HEADER_SIZE, Command, Header, Message
+from tigerbeetle_tpu.vsr.header import make_sealed as hdr_make_sealed
 
 log = logging.getLogger("tigerbeetle_tpu.bus")
 
@@ -52,6 +54,12 @@ class _Conn:
         self.writer = writer
         self.dropped = 0  # tidy: owner=loop
         self._sends = 0  # tidy: owner=loop
+        # Send coalescing: queued chunks flushed as ONE writelines per
+        # loop wakeup (see _enqueue) — a commit burst's replies cost one
+        # transport write instead of one syscall attempt per frame.
+        self._pending: list = []  # tidy: owner=loop
+        self._pending_bytes = 0  # tidy: owner=loop
+        self._flush_scheduled = False  # tidy: owner=loop
         # Per-connection gauge identity (a single global would flap
         # between unrelated transports). Built LAZILY at the first
         # sampled send (see _gauge_name): connection churn at the
@@ -89,7 +97,7 @@ class _Conn:
         transport = self.writer.transport
         buffered = (
             transport.get_write_buffer_size() if transport is not None else 0
-        )
+        ) + self._pending_bytes
         self._sends += 1
         over = transport is not None and buffered + size > limit
         if over or (self._sends & self.SENDQ_SAMPLE_MASK) == 0:
@@ -111,26 +119,75 @@ class _Conn:
         must not silently demote view-protocol frames to the bulk
         budget."""
         if self._can_send(len(data), command):
-            self.writer.write(data)
-            tracer.count("bus.tx_messages")
-            tracer.count("bus.tx_bytes", len(data))
+            self._enqueue((data,), len(data))
 
     def send_message(self, msg: Message) -> None:
         """Frame a message without concatenating header+body (a ~1 MiB
         copy per prepare on the old path)."""
-        if self._can_send(HEADER_SIZE + len(msg.body), msg.header["command"]):
-            self.writer.write(msg.header.to_bytes())
-            if msg.body:
-                self.writer.write(msg.body)
-            tracer.count("bus.tx_messages")
-            tracer.count("bus.tx_bytes", HEADER_SIZE + len(msg.body))
+        size = HEADER_SIZE + len(msg.body)
+        if self._can_send(size, msg.header["command"]):
+            self._enqueue(
+                (msg.header.to_bytes(), msg.body) if msg.body
+                else (msg.header.to_bytes(),),
+                size,
+            )
+
+    def _enqueue(self, chunks: tuple, size: int) -> None:
+        """Queue chunks and flush once per loop wakeup: a burst of small
+        reply frames (one per committed request) becomes ONE
+        `writelines` — one transport write and at most one syscall —
+        instead of a send attempt per frame. Outside a running loop
+        (unit harnesses, net-fault's call_later shims) the flush runs
+        inline, preserving the old write-through behavior."""
+        self._pending.extend(chunks)
+        self._pending_bytes += size
+        tracer.count("bus.tx_messages")
+        tracer.count("bus.tx_bytes", size)
+        if self._flush_scheduled:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            self._flush()
+            return
+        self._flush_scheduled = True
+        loop.call_soon(self._flush)
+
+    def _flush(self) -> None:
+        self._flush_scheduled = False
+        chunks, self._pending = self._pending, []
+        self._pending_bytes = 0
+        if not chunks or self.writer.is_closing():
+            return
+        self.writer.writelines(chunks)
+        tracer.count("bus.tx_flushes")
 
 
 _algo_mismatch_logged = False
 
 
-async def read_message(reader: asyncio.StreamReader) -> Optional[Message]:
+def _note_header_checksum_fail(hraw: bytes) -> None:
+    """Shared diagnostic for a header-MAC reject (Python and native
+    paths): distinguish a misconfigured cluster from corruption —
+    replicas formatted/running under a different TIGERBEETLE_TPU_CHECKSUM
+    would otherwise fail every MAC silently and never form quorum."""
     global _algo_mismatch_logged
+    if _algo_mismatch_logged or len(hraw) < HEADER_SIZE:
+        return
+    if Header.from_bytes(hraw[:HEADER_SIZE]).checksum_algorithm_mismatch():
+        _algo_mismatch_logged = True
+        from tigerbeetle_tpu.vsr.header import CHECKSUM_ALGORITHM
+
+        log.error(
+            "peer message authenticates under the OTHER checksum "
+            "algorithm (this host: %s): the cluster is split between "
+            "aegis128l and blake2b hosts — set TIGERBEETLE_TPU_CHECKSUM "
+            "identically on every replica. Dropping all such traffic.",
+            CHECKSUM_ALGORITHM,
+        )
+
+
+async def read_message(reader: asyncio.StreamReader) -> Optional[Message]:
     try:
         hraw = await reader.readexactly(HEADER_SIZE)
     except (asyncio.IncompleteReadError, OSError):
@@ -146,20 +203,7 @@ async def read_message(reader: asyncio.StreamReader) -> Optional[Message]:
         # can never resync past corrupt bytes, so reconnect-clean is the
         # recovery (every VSR message is retried/re-derived).
         tracer.count("bus.rx_checksum_fail")
-        # Distinguish a misconfigured cluster from corruption: replicas
-        # formatted/running under a different TIGERBEETLE_TPU_CHECKSUM
-        # would otherwise fail every MAC silently and never form quorum.
-        if not _algo_mismatch_logged and h.checksum_algorithm_mismatch():
-            _algo_mismatch_logged = True
-            from tigerbeetle_tpu.vsr.header import CHECKSUM_ALGORITHM
-
-            log.error(
-                "peer message authenticates under the OTHER checksum "
-                "algorithm (this host: %s): the cluster is split between "
-                "aegis128l and blake2b hosts — set TIGERBEETLE_TPU_CHECKSUM "
-                "identically on every replica. Dropping all such traffic.",
-                CHECKSUM_ALGORITHM,
-            )
+        _note_header_checksum_fail(hraw)
         return None
     size = h["size"]
     if size < HEADER_SIZE or size > (1 << 21):
@@ -174,11 +218,114 @@ async def read_message(reader: asyncio.StreamReader) -> Optional[Message]:
     with tracer.span("stage.parse"):
         ok = h.valid_checksum_body(body)
     if ok:
+        # Both MACs verified at this ingress: the replica's on_message
+        # defense re-verify is skipped (same bytes, same answer).
+        msg.verified = True
         tracer.count("bus.rx_messages")
         tracer.count("bus.rx_bytes", size)
     else:
         tracer.count("bus.rx_checksum_fail")
     return msg if ok else None
+
+
+class NativeFrameSource:
+    """Batch frame ingress off a StreamReader through the C scanner
+    (docs/NATIVE_DATAPATH.md): each socket read's bytes are scanned —
+    header parse, size bounds, header+body MAC — in ONE GIL-releasing
+    call, and every complete frame is materialized with a ZERO-COPY
+    memoryview body into the receive buffer. Counter semantics match
+    read_message exactly (rx_messages / rx_bytes per frame,
+    rx_checksum_fail + connection drop on a MAC reject, silent drop on
+    an insane size field)."""
+
+    # Socket read budget per scan. StreamReader.read returns whatever is
+    # buffered up to this, so a chunk usually holds MANY small frames —
+    # the per-frame asyncio future machinery of readexactly is gone.
+    CHUNK = 1 << 18
+
+    __slots__ = ("_reader", "_scanner", "_parts", "_len", "_need", "_dead")
+
+    def __init__(self, reader: asyncio.StreamReader, scanner) -> None:
+        self._reader = reader
+        self._scanner = scanner
+        # Accumulated unparsed chunks. Joined only when enough bytes for
+        # the next frame arrived (`_need`, maintained by the C scanner
+        # from verified headers) — a 1 MiB prepare body arriving in
+        # socket-sized chunks is joined once, not re-joined per read.
+        self._parts: list = []
+        self._len = 0
+        self._need = HEADER_SIZE
+        self._dead = False
+
+    async def next_batch(self) -> Optional[List[Message]]:
+        """The next batch of verified messages (≥1), or None when the
+        connection is done (EOF, socket error, or a checksum reject —
+        framing can never resync past corrupt bytes, so the connection
+        drops, exactly like read_message)."""
+        while not self._dead:
+            if self._len >= self._need:
+                buf = (
+                    self._parts[0] if len(self._parts) == 1
+                    else b"".join(self._parts)
+                )
+                with tracer.span("bus.scan"):
+                    rows, consumed, need, status = self._scanner.scan(buf)
+                tail = buf[consumed:] if consumed < len(buf) else b""
+                self._parts = [tail] if tail else []
+                self._len = len(tail)
+                self._need = need - consumed
+                if status != codec.STATUS_OK:
+                    # Frames ahead of the corrupt one still dispatch (they
+                    # were verified); the NEXT call returns None and the
+                    # caller drops the connection.
+                    self._dead = True
+                    if status in (
+                        codec.STATUS_HEADER_MAC, codec.STATUS_BODY_MAC
+                    ):
+                        tracer.count("bus.rx_checksum_fail")
+                        if status == codec.STATUS_HEADER_MAC:
+                            _note_header_checksum_fail(tail[:HEADER_SIZE])
+                if len(rows):
+                    with tracer.span("bus.decode"):
+                        msgs = codec.messages_from_scan(buf, rows)
+                    tracer.count("bus.rx_messages", len(msgs))
+                    tracer.count("bus.rx_bytes", consumed)
+                    return msgs
+                if self._dead:
+                    return None
+            try:
+                chunk = await self._reader.read(self.CHUNK)
+            except OSError:
+                return None
+            if not chunk:
+                return None  # EOF (a partial tail is an incomplete frame)
+            self._parts.append(chunk)
+            self._len += len(chunk)
+        return None
+
+
+class PythonFrameSource:
+    """read_message as a batch-of-one source (the no-toolchain/blake2b
+    fallback — byte-identical parse semantics, unchanged code path)."""
+
+    __slots__ = ("_reader",)
+
+    def __init__(self, reader: asyncio.StreamReader) -> None:
+        self._reader = reader
+
+    async def next_batch(self) -> Optional[List[Message]]:
+        msg = await read_message(self._reader)
+        return None if msg is None else [msg]
+
+
+def frame_source(reader: asyncio.StreamReader):
+    """The ingress for a server connection: native batch scanner when
+    the codec is enabled, else the pure-Python parser."""
+    sc = codec.scanner()
+    return (
+        NativeFrameSource(reader, sc) if sc is not None
+        else PythonFrameSource(reader)
+    )
 
 
 class NetFault:
@@ -522,10 +669,17 @@ class ReplicaServer:
         # One connection may carry MANY client sessions (AsyncClient
         # multiplexes its session pool over a single socket) — map each.
         client_ids: set[int] = set()
+        source = frame_source(reader)
+        batch: List[Message] = []
+        ix = 0
         while not self._stopping.is_set():
-            msg = await read_message(reader)
-            if msg is None:
-                break
+            if ix >= len(batch):
+                nxt = await source.next_batch()
+                if nxt is None:
+                    break
+                batch, ix = nxt, 0
+            msg = batch[ix]
+            ix += 1
             h = msg.header
             cmd = h["command"]
             if cmd == Command.PING_CLIENT and h["client"] != 0:
@@ -539,11 +693,10 @@ class ReplicaServer:
                 # (reference ping_client/pong_client, vsr/client.zig view
                 # discovery).
                 r = self.replica
-                pong = Header(
-                    None, command=Command.PONG_CLIENT, cluster=r.cluster,
-                    replica=self.me_index, view=r.view, client=h["client"],
-                )
-                conn.send(Message(pong).seal().to_bytes())
+                conn.send(hdr_make_sealed(
+                    Command.PONG_CLIENT, r.cluster, replica=self.me_index,
+                    view=r.view, client=h["client"],
+                ).to_bytes())
                 continue  # hello is transport-level, not for the replica
             if cmd == Command.REQUEST:
                 if h["client"] != 0 and tracer.enabled() and self.replica.is_primary:
@@ -635,14 +788,16 @@ class ReplicaServer:
         writer.close()
 
     async def _read_loop(self, reader: asyncio.StreamReader, expected_replica: int) -> None:
+        source = frame_source(reader)
         while not self._stopping.is_set():
-            msg = await read_message(reader)
-            if msg is None:
+            batch = await source.next_batch()
+            if batch is None:
                 return
-            if (
-                self.net_fault is not None
-                and expected_replica in self.net_fault.blackhole
-            ):
-                tracer.count("bus.fault.blackholed")
-                continue
-            self._dispatch(msg)
+            for msg in batch:
+                if (
+                    self.net_fault is not None
+                    and expected_replica in self.net_fault.blackhole
+                ):
+                    tracer.count("bus.fault.blackholed")
+                    continue
+                self._dispatch(msg)

@@ -17,6 +17,7 @@ import numpy as np
 
 from tigerbeetle_tpu import types
 from tigerbeetle_tpu.constants import TEST_MIN, Config
+from tigerbeetle_tpu.net import codec
 from tigerbeetle_tpu.io.storage import MemStorage, Zone
 from tigerbeetle_tpu.vsr import header as hdr
 from tigerbeetle_tpu.vsr.header import Command, Message, Operation
@@ -367,7 +368,15 @@ class Cluster:
             time.sleep(0.0002)
         for dst, data in self.net.deliver_due():
             kind, ident = dst
-            msg = Message.from_bytes(data)
+            # Wire ingress through the codec: the native scan (when
+            # enabled) parses + verifies exactly as the TCP bus does, so
+            # the native-vs-Python determinism guard
+            # (tests/test_native_bus.py) exercises the real decode path;
+            # the fallback is the old unverified from_bytes (on_message
+            # re-verifies it).
+            msg = codec.decode_frame(data)
+            if msg is None:
+                continue  # native scan rejected the frame (corruption)
             if kind == "replica":
                 r = self.replicas[ident]
                 if r is not None:

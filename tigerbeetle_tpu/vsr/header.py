@@ -190,6 +190,21 @@ def _select_checksum():
 
 _checksum_fn, CHECKSUM_ALGORITHM = _select_checksum()
 
+_codec = None  # net.codec module when the native bus is enabled, else False
+
+
+def _native_codec():
+    """The native framed codec (net/codec.py) when enabled for this
+    process, else None. Lazy: codec imports this module, so the cycle
+    resolves at first call, and the probe result is cached — the hot
+    encode paths pay one global read."""
+    global _codec
+    if _codec is None:
+        from tigerbeetle_tpu.net import codec
+
+        _codec = codec if codec.enabled() else False
+    return _codec or None
+
 
 def checksum(data: bytes | memoryview) -> int:
     """128-bit MAC over headers, bodies, and grid blocks."""
@@ -303,6 +318,23 @@ class ReplyBuilder:
     def build_one(self, s: dict) -> "Message":
         """s: view/op/timestamp/request/replica/operation/cluster/client
         + body (bytes) → sealed reply Message."""
+        codec = _native_codec()
+        if codec is not None:
+            # Native encode: field stores + both MACs in one GIL-releasing
+            # C call into a fresh record (replies outlive the builder, so
+            # a fresh 256-byte record replaces the scratch + copy-out).
+            from tigerbeetle_tpu import tracer
+
+            with tracer.span("bus.encode"):
+                rec = np.empty(1, dtype=HEADER_DTYPE)
+                codec.encode_header_into(
+                    rec, s["body"], command=Command.REPLY,
+                    cluster=s["cluster"], client=s["client"],
+                    view=s["view"], op=s["op"], commit=s["op"],
+                    timestamp=s["timestamp"], request=s["request"],
+                    replica=s["replica"], operation=s["operation"],
+                )
+            return Message(Header(rec[0]), s["body"])
         self._recs[0] = np.zeros((), dtype=HEADER_DTYPE)
         rec = self._recs[0]
         rec["version"] = 1
@@ -334,6 +366,22 @@ def make(command: int, cluster: int = 0, **fields) -> Header:
     return h
 
 
+def make_sealed(
+    command: int, cluster: int = 0, body: bytes = b"", **fields
+) -> "Message":
+    """Sealed outbound frame: `make(...)` + `Message(...).seal()` fused
+    through the native encoder when enabled (one C call instead of ~15
+    numpy scalar stores + two ctypes MACs). Byte-identical either way —
+    the hot small-frame paths (replies, BUSY sheds, pongs, client
+    requests) call this."""
+    codec = _native_codec()
+    if codec is not None:
+        return codec.encode_message(
+            body, command=command, cluster=cluster, **fields
+        )
+    return Message(make(command, cluster, **fields), body).seal()
+
+
 class Message:
     """Header + body; checksums sealed on send."""
 
@@ -341,12 +389,16 @@ class Message:
     # bus arrival through prepare/WAL/commit/reply (tracer.py per-op
     # lifecycle layer). None when tracing is off or the message is not a
     # tracked request/prepare; never serialized.
-    __slots__ = ("header", "body", "lifecycle")
+    # verified: both checksums already MAC-checked at the bus ingress
+    # (native scan or read_message) — the replica's on_message defense
+    # re-verify is skipped for these. Never serialized; copies reset it.
+    __slots__ = ("header", "body", "lifecycle", "verified")
 
     def __init__(self, header: Header, body: bytes = b"") -> None:
         self.header = header
         self.body = body
         self.lifecycle = None
+        self.verified = False
 
     def seal(self) -> "Message":
         self.header.set_checksum_body(self.body)
@@ -364,7 +416,12 @@ class Message:
         return self
 
     def to_bytes(self) -> bytes:
-        return self.header.to_bytes() + self.body
+        # join, not +: zero-copy bodies off the native receive ring are
+        # memoryviews, which bytes.__add__ rejects.
+        return (
+            b"".join((self.header.to_bytes(), self.body))
+            if self.body else self.header.to_bytes()
+        )
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "Message":

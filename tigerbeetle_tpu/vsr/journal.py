@@ -109,32 +109,52 @@ class WalWriter:
                 # the Perfetto timeline, and the durable-write latency
                 # histogram (as opposed to stage.wal, the loop-side
                 # enqueue cost).
+                # Buffered segments (the redundant header ring; every
+                # segment on the no-direct path) go through write_batch:
+                # on FileStorage + busio that is ONE GIL-releasing native
+                # pwritev per entry/batch instead of a Python pwrite per
+                # chunk (docs/NATIVE_DATAPATH.md WAL ring writes).
+                write_batch = getattr(self._storage, "write_batch", None)
+
+                def _flat(segs):
+                    out = []
+                    for offset, chunks, _durable in segs:
+                        pos = offset
+                        for c in chunks:
+                            out.append((pos, c))
+                            pos += len(c)
+                    return out
+
                 if getattr(self._storage, "supports_direct", False):
                     for segments, cb, lc in batch:
                         tracer.op_stamp(lc, tracer.OP_WAL_WRITE)
                         with tracer.span("wal.write"):
+                            buffered = []
                             for offset, chunks, durable in segments or ():
                                 if durable:
                                     self._storage.write_durable(offset, chunks)
                                 else:
-                                    pos = offset
-                                    for c in chunks:
+                                    buffered.append((offset, chunks, durable))
+                            if buffered:
+                                if write_batch is not None:
+                                    write_batch(_flat(buffered))
+                                else:
+                                    for pos, c in _flat(buffered):
                                         self._storage.write(pos, c)
-                                        pos += len(c)
                         tracer.op_stamp(lc, tracer.OP_WAL_DURABLE)
                         self._post(cb)
                 else:
                     with tracer.span("wal.write"):
-                        wrote = False
+                        flat = []
                         for segments, _cb, lc in batch:
                             tracer.op_stamp(lc, tracer.OP_WAL_WRITE)
-                            for offset, chunks, _durable in segments or ():
-                                pos = offset
-                                for c in chunks:
+                            flat.extend(_flat(segments or ()))
+                        if flat:
+                            if write_batch is not None:
+                                write_batch(flat)
+                            else:
+                                for pos, c in flat:
                                     self._storage.write(pos, c)
-                                    pos += len(c)
-                                wrote = True
-                        if wrote:
                             self._storage.sync()
                     for _segments, cb, lc in batch:
                         # Group-commit shape: the batch is durable at the

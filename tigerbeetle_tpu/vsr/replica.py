@@ -761,7 +761,11 @@ class Replica:
     def on_message(self, msg: Message) -> None:
         if self.retired:
             return
-        if not msg.verify():
+        # `verified` = both MACs already checked at the bus ingress (C
+        # scan or read_message) — same bytes, same answer, so the defense
+        # re-verify only runs for messages that arrived another way (the
+        # packet simulator, unit harnesses, direct embedders).
+        if not (msg.verified or msg.verify()):
             return
         h = msg.header
         if h["cluster"] != self.cluster:
@@ -889,11 +893,10 @@ class Replica:
                 # catch-up. The floor is the election-time op, so steady-
                 # state pipelining never suppresses genuine evictions.
                 return
-            evict = hdr.make(
+            self.bus.send_to_client(client, hdr.make_sealed(
                 Command.EVICTION, self.cluster, client=client,
                 replica=self.replica, view=self.view,
-            )
-            self.bus.send_to_client(client, Message(evict).seal())
+            ))
             return
         if h["request"] <= sess.request:
             if h["request"] == sess.request and sess.reply is not None:
@@ -977,11 +980,10 @@ class Replica:
         saturation costs unbounded queue-wait for everyone."""
         tracer.count("vsr.sheds")
         tracer.count(f"vsr.sheds.{reason}")
-        busy = hdr.make(
+        self.bus.send_to_client(h["client"], hdr.make_sealed(
             Command.BUSY, self.cluster, client=h["client"],
             request=h["request"], replica=self.replica, view=self.view,
-        )
-        self.bus.send_to_client(h["client"], Message(busy).seal())
+        ))
 
     def _admission_full(self) -> Optional[str]:
         """Shed reason when the door is saturated, else None. Queue-depth
@@ -3255,13 +3257,15 @@ class Replica:
         if client != 0:
             if build_reply:
                 with tracer.span("stage.reply"):
-                    rh = hdr.make(
-                        Command.REPLY, self.cluster,
+                    # make_sealed: one C call (fields + both MACs) on the
+                    # native datapath, make+seal on the fallback.
+                    reply = hdr.make_sealed(
+                        Command.REPLY, self.cluster, body=results,
                         view=self.view, op=op_num, commit=op_num,
-                        timestamp=h["timestamp"], client=client, request=h["request"],
-                        replica=self.replica, operation=operation,
+                        timestamp=h["timestamp"], client=client,
+                        request=h["request"], replica=self.replica,
+                        operation=operation,
                     )
-                    reply = Message(rh, results).seal()
             else:
                 spec = {
                     "view": self.view, "op": op_num,

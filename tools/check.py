@@ -67,6 +67,31 @@ def check_devhub(strict_new: bool = False) -> dict:
         ]}
 
 
+def check_codec() -> dict:
+    """The native-codec build probe (docs/NATIVE_DATAPATH.md): compile
+    csrc/busio.c and run the golden-vector cross-check against the pure-
+    Python encoding (codec.golden_check). A host that cannot build the
+    shim (no AES-NI / no compiler / blake2b checksum) is a benign skip —
+    the Python bus is the contract there — but a BUILT codec that drifts
+    from the Python reference fails this entry point like any analyzer
+    finding: silent wire-format divergence is a cluster-corruption bug,
+    not a perf knob."""
+    try:
+        from tigerbeetle_tpu.net import codec
+
+        if not codec.enabled():
+            return {"ran": False, "failures": [],
+                    "note": "codec unavailable (pure-Python bus)"}
+        failures = [f"codec golden vector: {f}" for f in codec.golden_check()]
+        return {"ran": True, "failures": failures}
+    except Exception as e:  # noqa: BLE001 — probe errors fail closed
+        err = f"{type(e).__name__}: {e}"
+        return {"ran": False, "failures": [
+            f"codec build probe errored ({err}) — the native bus would "
+            "run unchecked; fix the shim or set TIGERBEETLE_TPU_NATIVE_BUS=0"
+        ], "note": err}
+
+
 def _pass_names():
     from tigerbeetle_tpu import tidy
 
@@ -143,6 +168,13 @@ def main(argv=None) -> int:
         else {"ran": False, "failures": [], "steps": 0, "note": "root override"}
     )
     report["devhub"] = devhub_report
+    # Ninth pass — the native-codec build probe + golden vectors (always
+    # gating when the shim builds: wire-format drift is corruption).
+    codec_report = (
+        check_codec() if args.root is None
+        else {"ran": False, "failures": [], "note": "root override"}
+    )
+    report["codec"] = codec_report
 
     if args.json:
         print(json.dumps(report, indent=2))
@@ -164,12 +196,21 @@ def main(argv=None) -> int:
                   f"regression(s) ({mode})")
         else:
             print(f"devhub: skipped ({devhub_report.get('note', '')})")
+        for f in codec_report["failures"]:
+            print(f"codec: {f}")
+        if codec_report["ran"]:
+            print(f"codec: built, {len(codec_report['failures'])} golden-"
+                  "vector failure(s)")
+        else:
+            print(f"codec: skipped ({codec_report.get('note', '')})")
         print(
             f"check: {len(report['new'])} new, {len(report['suppressed'])} "
             f"baselined, {len(report['stale_baseline_keys'])} stale "
             f"(passes: {', '.join(report['passes'])} + devhub)"
         )
     if report["new"]:
+        return 1
+    if codec_report["failures"]:
         return 1
     if args.strict_stale and report["stale_baseline_keys"]:
         return 1
