@@ -761,6 +761,23 @@ def bench_e2e():
     return med
 
 
+def bench_cluster_plane():
+    """Cluster-plane objectives (docs/OBSERVABILITY.md "cluster plane"):
+    a real 3-process TCP cluster with ONE NetFault-delayed backup link
+    (delay_to=<primary> on the backup — one slow LINK, not a slow
+    host), batched transfers at the primary, then the gated
+    replication_lag_p99_ms / quorum_straggler_p99_ms read back from the
+    primary's /lifecycle flat keys plus the per-peer separation
+    evidence from /cluster. The injected delay dominates both gated
+    distributions, so the >10% rule tracks the telemetry/replication
+    plane, not host noise. A crashed run records an error entry without
+    the gated keys → MISSING → fail-closed once a baseline records
+    them."""
+    from tigerbeetle_tpu.testing import chaos
+
+    return chaos.run_cluster_plane_bench()
+
+
 def bench_overload():
     """Front-door overload objectives (docs/FRONT_DOOR.md): a real
     `cli.py start` replica under the open-loop harness
@@ -801,15 +818,17 @@ def bench_recovery():
 
 
 # Section registry, in execution order. The ordering is load-bearing:
-# the first three fork server/client processes onto this host's cores
+# the first four fork server/client processes onto this host's cores
 # and the parent must not yet hold jax runtime threads (device dispatch/
 # tunnel keepalive) competing for them — end_to_end first, then the
-# recovery and overload sections (loadgen/chaos are numpy + asyncio
-# only), and only then the in-parent device configs that import jax.
+# recovery, overload, and cluster-plane sections (loadgen/chaos are
+# numpy + asyncio only), and only then the in-parent device configs
+# that import jax.
 SECTIONS = (
     ("end_to_end", bench_e2e),
     ("recovery", bench_recovery),
     ("overload", bench_overload),
+    ("cluster_plane", bench_cluster_plane),
     ("config1_default", bench_config1),
     ("config2_zipf", bench_config2_zipf),
     ("config3_linked_pending", lambda: bench_exact("config3")),

@@ -1,0 +1,762 @@
+"""Cluster-plane observability (ISSUE 15): per-peer replication
+telemetry, quorum-wait attribution, clock-offset estimation, merged
+cluster traces, and the determinism guarantee that none of it touches a
+replicated byte.
+
+Layers under test:
+  - vsr/peerstats.py      broadcast → per-peer prepare_ok stamps on the
+                          pooled OpRecord, quorum completion/straggler
+                          attribution, replication-lag gauges
+  - vsr/clocksync.py      per-peer offset/RTT windows + Marzullo skew
+                          bound (estimation only — never feeds state)
+  - net/bus.py            per-peer tx/rx counters, gauge retirement on
+                          unmap, NetFault delay_to (one slow LINK)
+  - tracer.py             OpRecord peer fields + recycle guard, flat
+                          replication_lag/quorum_straggler keys, /trace
+                          timebase, serve_metrics extra routes
+  - tools/cluster_trace   offset-aligned merged Perfetto traces
+  - tools/cluster_top     /cluster aggregation table
+  - tools/trace_summary   per-peer sub-rows in --ops waterfalls
+  - tools/bench_gate      cluster_plane gated keys, n/a vs BENCH_r06
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from tigerbeetle_tpu import tracer  # noqa: E402
+from tigerbeetle_tpu.vsr.clocksync import ClockSync  # noqa: E402
+from tigerbeetle_tpu.vsr.peerstats import PeerStats, cluster_status  # noqa: E402
+
+
+@pytest.fixture
+def clean_tracer():
+    """Enabled + reset tracer, restored to prior state afterwards."""
+    was = tracer.enabled()
+    tracer.enable()
+    tracer.reset()
+    yield
+    tracer.reset()
+    if not was:
+        tracer.disable()
+
+
+def _load_tool(name):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        f"tool_{name}_cp", os.path.join(REPO, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# --- PeerStats unit -------------------------------------------------------
+
+
+class TestPeerStats:
+    def _counters(self):
+        snap = tracer.snapshot()
+        return {k: v["count"] for k, v in snap.items()
+                if k.startswith(("vsr.peer.", "vsr.quorum", "vsr.replication"))}
+
+    def test_quorum_attribution_and_straggler(self, clean_tracer):
+        ps = PeerStats(0, 3)
+        rec = tracer.op_begin()
+        ps.broadcast(7, rec)
+        assert rec.peers_open and rec.peer_bcast > 0
+        ps.ack(7, 0, quorum=2)   # self WAL-durable ack
+        assert rec.quorum_t == 0
+        ps.ack(7, 1, quorum=2)   # completes the quorum
+        assert rec.quorum_t > 0 and rec.quorum_peer == 1
+        ps.ack(7, 2, quorum=2)   # straggler; also the last ack → window closes
+        assert not rec.peers_open and ps.tracked() == 0
+        c = self._counters()
+        assert c.get("vsr.peer.1.quorum_complete") == 1
+        assert c.get("vsr.peer.2.quorum_straggler") == 1
+        assert "vsr.peer.1.quorum_straggler" not in c
+        # Remote acks feed the per-peer + aggregate histograms; the
+        # self-ack does not (replication lag is about the network).
+        assert c.get("vsr.peer.1.prepare_ok") == 1
+        assert c.get("vsr.peer.2.prepare_ok") == 1
+        assert "vsr.peer.0.prepare_ok" not in c
+        assert c.get("vsr.replication.lag") == 2
+        assert c.get("vsr.quorum.straggler") == 1
+        assert ps.acked_op == [7, 7, 7]
+
+    def test_self_straggler_counted_but_not_in_gated_histogram(
+        self, clean_tracer,
+    ):
+        """A slow local group-fsync arriving after both backups is
+        NAMED (per-peer counter) but its overhang stays out of the
+        gated vsr.quorum.straggler histogram — the baseline measures
+        peer links, not local fsync latency."""
+        ps = PeerStats(0, 3)
+        rec = tracer.op_begin()
+        ps.broadcast(4, rec)
+        ps.ack(4, 1, quorum=2)
+        ps.ack(4, 2, quorum=2)  # remote acks complete the quorum
+        ps.ack(4, 0, quorum=2)  # the local fsync straggles in last
+        c = self._counters()
+        assert c.get("vsr.peer.0.quorum_straggler") == 1
+        assert "vsr.quorum.straggler" not in c
+
+    def test_duplicate_and_untracked_acks_ignored(self, clean_tracer):
+        ps = PeerStats(0, 3)
+        rec = tracer.op_begin()
+        ps.broadcast(3, rec)
+        ps.ack(3, 1, quorum=2)
+        ps.ack(3, 1, quorum=2)   # duplicate
+        ps.ack(99, 1, quorum=2)  # never broadcast
+        ps.ack(3, 7, quorum=2)   # out-of-range replica index
+        c = self._counters()
+        assert c.get("vsr.peer.1.prepare_ok") == 1
+        assert ps.acked_op[1] == 99  # high-water still tracks the ack
+
+    def test_track_bound_evicts_oldest_and_releases(self, clean_tracer):
+        from tigerbeetle_tpu.vsr import peerstats
+
+        ps = PeerStats(0, 3)
+        recs = []
+        for op in range(peerstats.TRACK_MAX + 5):
+            r = tracer.op_begin()
+            ps.broadcast(op, r)
+            recs.append(r)
+        assert ps.tracked() == peerstats.TRACK_MAX
+        assert all(not r.peers_open for r in recs[:5])
+        assert recs[-1].peers_open
+
+    def test_close_all_never_fabricates(self, clean_tracer):
+        ps = PeerStats(0, 3)
+        rec = tracer.op_begin()
+        ps.broadcast(5, rec)
+        ps.ack(5, 1, quorum=2)
+        ps.close_all()
+        assert ps.tracked() == 0 and not rec.peers_open
+        # Partial: peer 1 stamped, peer 2 never fabricated, no quorum.
+        assert rec.peer_t[1] > 0 and rec.peer_t[2] == 0
+        assert rec.quorum_t == 0
+
+    def test_commit_sample_lag_gauges(self, clean_tracer):
+        ps = PeerStats(1, 3)
+        rec = tracer.op_begin()
+        ps.broadcast(10, rec)
+        ps.ack(10, 0, quorum=2)
+        ps.commit_sample(12, 10)
+        g = tracer.gauges()
+        assert g.get("vsr.peer.0.replication_lag_ops") == 2
+        assert g.get("vsr.peer.2.replication_lag_ops") == 12
+        assert "vsr.peer.1.replication_lag_ops" not in g  # self
+
+
+# --- OpRecord recycle guard ----------------------------------------------
+
+
+class TestOpRecordPeerRecycle:
+    def test_peers_open_blocks_recycle(self, clean_tracer):
+        tracer.configure_flight(ring=1)
+        try:
+            held = tracer.op_begin()
+            held.peers_open = True
+            held.released = True
+            tracer.op_stamp(held, tracer.OP_ARRIVE, 1)
+            tracer.op_stamp(held, tracer.OP_REPLY, 2)
+            tracer.op_finish(held)
+            free = tracer.op_begin()
+            free.released = True
+            tracer.op_stamp(free, tracer.OP_ARRIVE, 1)
+            tracer.op_stamp(free, tracer.OP_REPLY, 2)
+            tracer.op_finish(free)  # evicts `held` — open window: GC, not pool
+            third = tracer.op_begin()
+            assert third is not held
+            tracer.op_stamp(third, tracer.OP_ARRIVE, 1)
+            tracer.op_stamp(third, tracer.OP_REPLY, 2)
+            tracer.op_finish(third)  # evicts `free` — recyclable
+            fourth = tracer.op_begin()
+            assert fourth is free
+        finally:
+            tracer.configure_flight(ring=tracer.OP_RING_DEFAULT)
+
+    def test_peer_release_reoffers_evicted_record(self, clean_tracer):
+        """A down peer holds windows open past the ring's eviction
+        horizon; when the tracker finally lets go, the record must
+        return to the pool — the pool must not starve for the whole
+        outage (exactly when the plane matters)."""
+        tracer.configure_flight(ring=1)
+        try:
+            held = tracer.op_begin()
+            held.peers_open = True
+            held.released = True
+            tracer.op_stamp(held, tracer.OP_ARRIVE, 1)
+            tracer.op_stamp(held, tracer.OP_REPLY, 2)
+            tracer.op_finish(held)
+            other = tracer.op_begin()
+            other.released = True
+            tracer.op_stamp(other, tracer.OP_ARRIVE, 1)
+            tracer.op_stamp(other, tracer.OP_REPLY, 2)
+            tracer.op_finish(other)  # evicts `held` past the open window
+            assert held.ring_evicted
+            tracer.op_peer_release(held)  # the tracker lets go
+            assert tracer.op_begin() is held
+        finally:
+            tracer.configure_flight(ring=tracer.OP_RING_DEFAULT)
+
+    def test_record_dict_carries_peer_rows(self, clean_tracer):
+        rec = tracer.op_begin()
+        rec.peer_bcast = 1000
+        rec.peer_t[1] = 2000
+        rec.peer_t[2] = 4_001_000
+        rec.quorum_t = 2000
+        rec.quorum_peer = 1
+        d = tracer.op_record_dict(rec)
+        assert d["peer_ok_ms"] == {"1": 0.001, "2": 4.0}
+        assert d["quorum_ms"] == 0.001 and d["quorum_peer"] == 1
+
+
+# --- ClockSync unit -------------------------------------------------------
+
+
+class TestClockSync:
+    MS = 1_000_000
+
+    def test_offset_and_rtt_estimation(self, clean_tracer):
+        cs = ClockSync(0, 3)
+        # Peer 1's wall clock runs 50 ms ahead; symmetric 2 ms RTT.
+        m0, m1 = 1000 * self.MS, 1002 * self.MS
+        t_remote = (1001 + 50) * self.MS
+        cs.learn(1, m0, t_remote, m1, realtime_ns=1002 * self.MS,
+                 monotonic_ns=m1)
+        off, rtt = cs.best(1)
+        assert rtt == 2 * self.MS
+        assert abs(off - 50 * self.MS) <= 1 * self.MS
+        g = tracer.gauges()
+        assert abs(g["vsr.peer.1.clock_offset_ms"] - 50.0) <= 1.0
+        assert g["vsr.peer.1.rtt_ms"] == 2.0
+
+    def test_best_sample_is_min_rtt(self, clean_tracer):
+        cs = ClockSync(0, 3)
+        for rtt_ms, skew_ms in ((20, 90), (4, 50), (12, 70)):
+            m0 = 1000 * self.MS
+            m1 = m0 + rtt_ms * self.MS
+            cs.learn(1, m0, m1 - (rtt_ms // 2) * self.MS + skew_ms * self.MS,
+                     m1, realtime_ns=m1, monotonic_ns=m1)
+        off, rtt = cs.best(1)
+        assert rtt == 4 * self.MS
+        assert abs(off - 50 * self.MS) <= 1 * self.MS
+
+    def test_skew_bound_needs_quorum(self, clean_tracer):
+        cs = ClockSync(0, 3)
+        assert cs.skew_bound_ns is None
+        m0, m1 = 1000 * self.MS, 1001 * self.MS
+        cs.learn(1, m0, m1, m1, realtime_ns=m1, monotonic_ns=m1)
+        # self + peer 1 = 2 sources ≥ quorum(3)=2: bound published
+        assert cs.skew_bound_ns is not None
+        assert tracer.gauges().get("vsr.clock.sources") == 2
+
+    def test_peer_step_grows_bound_and_drops_agreement(self, clean_tracer):
+        """A peer's wall-clock STEP must SURFACE in the skew bound (the
+        pairwise span — NOT Marzullo's agreed-intersection width, which
+        collapses to 0 whenever the local clock sits in the majority and
+        would hide the step) while the agreement count drops."""
+        cs = ClockSync(0, 5)  # quorum 3
+        m0, m1 = 1000 * self.MS, 1001 * self.MS
+        cs.learn(1, m0, m1, m1, realtime_ns=m1, monotonic_ns=m1)
+        cs.learn(2, m0, m1, m1, realtime_ns=m1, monotonic_ns=m1)
+        healthy_bound = cs.skew_bound_ns
+        assert healthy_bound is not None and cs.sources == 3
+        # Peer 2's clock steps 10 minutes (its tighter lower-RTT sample
+        # wins the window): the bound jumps by the step, agreement drops
+        # to self + peer 1.
+        m0b = 2000 * self.MS
+        m1b = m0b + self.MS // 2
+        cs.learn(2, m0b, m1b + 600_000 * self.MS, m1b,
+                 realtime_ns=m1b, monotonic_ns=m1b)
+        assert cs.best(2)[1] == self.MS // 2  # the stepped sample won
+        assert cs.skew_bound_ns > 500_000 * self.MS
+        assert cs.sources == 2
+        g = tracer.gauges()
+        assert g["vsr.clock.skew_bound_ms"] > 500_000.0
+        assert g["vsr.clock.sources"] == 2
+
+    def test_skew_gauge_withdrawn_when_retire_breaks_quorum(
+        self, clean_tracer,
+    ):
+        cs = ClockSync(0, 3)
+        m0, m1 = 1000 * self.MS, 1001 * self.MS
+        cs.learn(1, m0, m1, m1, realtime_ns=m1, monotonic_ns=m1)
+        assert "vsr.clock.skew_bound_ms" in tracer.gauges()
+        cs.retire(1)  # back to self-only: below quorum
+        g = tracer.gauges()
+        assert "vsr.clock.skew_bound_ms" not in g
+        assert "vsr.clock.sources" not in g
+        assert cs.skew_bound_ns is None
+
+    def test_rtt_bounds_reject(self, clean_tracer):
+        from tigerbeetle_tpu.vsr import clocksync
+
+        cs = ClockSync(0, 3)
+        cs.learn(1, 1000, 500, 999, realtime_ns=0, monotonic_ns=0)  # rtt<0
+        cs.learn(1, 0, 0, clocksync.RTT_MAX_NS + 1_000_000_000,
+                 realtime_ns=0, monotonic_ns=0)
+        assert not cs.samples
+
+    def test_self_and_out_of_range_ignored(self, clean_tracer):
+        cs = ClockSync(1, 3)
+        cs.learn(1, 0, 0, 1000, realtime_ns=0, monotonic_ns=0)
+        cs.learn(5, 0, 0, 1000, realtime_ns=0, monotonic_ns=0)
+        assert not cs.samples
+
+
+# --- registry stability across peer churn (the round-9 leak class) -------
+
+
+class TestRegistryStability:
+    def test_peer_gauges_retire_on_unmap(self, clean_tracer):
+        ps = PeerStats(0, 3)
+        cs = ClockSync(0, 3)
+        MS = 1_000_000
+
+        def churn_once():
+            rec = tracer.op_begin()
+            ps.broadcast(1, rec)
+            ps.ack(1, 1, quorum=2)
+            ps.commit_sample(2, 1)
+            cs.learn(1, 1000 * MS, 1001 * MS, 1001 * MS,
+                     realtime_ns=1001 * MS, monotonic_ns=1001 * MS)
+            ps.close_all()
+            # the unmap path (Replica.peer_unmapped does exactly this)
+            cs.retire(1)
+            tracer.remove_gauges_prefix("vsr.peer.1.")
+
+        churn_once()
+        size_after_first = len(tracer.gauges())
+        for _ in range(50):
+            churn_once()
+        assert len(tracer.gauges()) == size_after_first
+        assert not any(
+            k.startswith("vsr.peer.1.") for k in tracer.gauges()
+        )
+
+    def test_replica_peer_unmapped_retires_family(self, clean_tracer):
+        tracer.gauge("vsr.peer.2.replication_lag_ops", 5)
+        tracer.gauge("vsr.peer.2.clock_offset_ms", 1.0)
+        tracer.gauge("vsr.peer.1.clock_offset_ms", 2.0)
+
+        class _R:
+            pass
+
+        from tigerbeetle_tpu.vsr.replica import Replica
+
+        r = _R()
+        r.clocksync = ClockSync(0, 3)
+        Replica.peer_unmapped(r, 2)
+        g = tracer.gauges()
+        assert not any(k.startswith("vsr.peer.2.") for k in g)
+        assert "vsr.peer.1.clock_offset_ms" in g
+
+
+# --- lifecycle flat keys --------------------------------------------------
+
+
+class TestFlatKeys:
+    def test_replication_keys_present_when_observed(self, clean_tracer):
+        tracer.observe("vsr.replication.lag", 5_000_000)
+        tracer.observe("vsr.quorum.straggler", 2_000_000)
+        flat = tracer.lifecycle_summary()["flat"]
+        assert flat["replication_lag_p99_ms"] > 0
+        assert flat["quorum_straggler_p99_ms"] > 0
+        assert "replication_lag_p50_ms" in flat
+
+    def test_absent_without_observations(self, clean_tracer):
+        flat = tracer.lifecycle_summary()["flat"]
+        assert "replication_lag_p99_ms" not in flat
+        assert "quorum_straggler_p99_ms" not in flat
+
+
+# --- in-process cluster: the full plane over the packet simulator ---------
+
+
+class TestClusterPlaneInProcess:
+    def _drive(self, cl, ops=8):
+        import numpy as np
+
+        from tests.test_cluster import do_request, setup_client
+        from tigerbeetle_tpu import types
+        from tigerbeetle_tpu.vsr.header import Operation
+
+        c = setup_client(cl)
+        ev = np.zeros(4, dtype=types.ACCOUNT_DTYPE)
+        ev["id_lo"] = np.arange(1, 5, dtype=np.uint64)
+        ev["ledger"] = 1
+        ev["code"] = 10
+        do_request(cl, c, Operation.CREATE_ACCOUNTS, ev.tobytes())
+        for b in range(ops):
+            tr = np.zeros(4, dtype=types.TRANSFER_DTYPE)
+            tr["id_lo"] = np.arange(1 + b * 4, 5 + b * 4, dtype=np.uint64)
+            tr["debit_account_id_lo"] = 1
+            tr["credit_account_id_lo"] = 2
+            tr["amount_lo"] = 1
+            tr["ledger"] = 1
+            tr["code"] = 7
+            do_request(cl, c, Operation.CREATE_TRANSFERS, tr.tobytes())
+        return c
+
+    def test_telemetry_populates(self, clean_tracer):
+        from tigerbeetle_tpu.testing.cluster import Cluster
+
+        cl = Cluster(replica_count=3, client_count=1)
+        try:
+            self._drive(cl)
+            snap = tracer.snapshot()
+            prim = next(
+                r for r in cl.replicas if r is not None and r.is_primary
+            )
+            peers = [r for r in range(3) if r != prim.replica]
+            for p in peers:
+                assert snap[f"vsr.peer.{p}.prepare_ok"]["count"] >= 8
+            completes = sum(
+                snap.get(f"vsr.peer.{r}.quorum_complete", {}).get("count", 0)
+                for r in range(3)
+            )
+            stragglers = sum(
+                snap.get(f"vsr.peer.{r}.quorum_straggler", {}).get("count", 0)
+                for r in range(3)
+            )
+            assert completes >= 8
+            assert stragglers >= 8  # 3-replica: one straggler per op
+            assert snap["vsr.replication.lag"]["count"] >= 16
+            flat = tracer.lifecycle_summary()["flat"]
+            assert flat["replication_lag_p99_ms"] > 0
+            assert flat["quorum_straggler_p99_ms"] > 0
+            # /cluster document schema off the live primary
+            st = cluster_status(prim)
+            assert set(st["peers"]) == {str(p) for p in peers}
+            for p in peers:
+                row = st["peers"][str(p)]
+                assert row["prepare_ok_count"] >= 8
+                assert "lag_ops" in row and "acked_op" in row
+                assert "clock_offset_ms" in row  # pings flowed
+            assert "timebase" in st
+            assert st["clock"]["sources"] == 3
+            # flight records carry the per-peer sub-rows
+            withpeers = [
+                r for r in tracer.flight_records() if "peer_ok_ms" in r
+            ]
+            assert withpeers
+            assert "quorum_peer" in withpeers[-1]
+        finally:
+            cl.close()
+
+    def test_disabled_tracer_is_inert(self):
+        from tigerbeetle_tpu.testing.cluster import Cluster
+
+        was = tracer.enabled()
+        tracer.disable()
+        try:
+            cl = Cluster(replica_count=3, client_count=1)
+            try:
+                self._drive(cl, ops=2)
+                prim = next(
+                    r for r in cl.replicas if r is not None and r.is_primary
+                )
+                assert prim.peer_stats.tracked() == 0
+                assert not prim.clocksync.samples
+            finally:
+                cl.close()
+        finally:
+            if was:
+                tracer.enable()
+
+
+class TestTelemetryDeterminism:
+    """Satellite: telemetry-on vs telemetry-off cluster runs must be
+    byte-identical in hash_log commit-checksum chains + checkpoint
+    trailer digests — the cluster plane observes, it never steers."""
+
+    def test_on_vs_off_byte_identical(self, tmp_path):
+        from tests.test_cluster import TestOverlappedPipeline
+        from tigerbeetle_tpu.testing.hash_log import HashLog
+
+        harness = TestOverlappedPipeline()
+        was = tracer.enabled()
+        tracer.disable()
+        try:
+            create = HashLog(str(tmp_path / "chain.log"), "create")
+            off = harness._drive(overlap=False, hash_log=create)
+            create.close()
+            tracer.enable()
+            tracer.reset()
+            check = HashLog(str(tmp_path / "chain.log"), "check")
+            on = harness._drive(overlap=False, hash_log=check)
+            check.close()
+            # The telemetry actually recorded during the ON run.
+            snap = tracer.snapshot()
+            assert any(
+                k.startswith("vsr.peer.") and k.endswith(".prepare_ok")
+                for k in snap
+            ), "telemetry-on run recorded no peer telemetry"
+            harness._check_runs_identical(off, on)
+        finally:
+            tracer.reset()
+            if was:
+                tracer.enable()
+            else:
+                tracer.disable()
+
+
+# --- real processes: NetFault delay → telemetry round trip ----------------
+
+
+class TestNetFaultTelemetryRoundTrip:
+    def test_delayed_backup_separates_and_merges(self):
+        """The acceptance run (wire-level fault → telemetry round
+        trip): 3 × `cli.py start` over TCP, one backup restarted under
+        NetFault delay_to=<primary>; the primary's scrape surface must
+        clearly separate the slow peer (prepare_ok p99), attribute the
+        stragglers to it by name, record the gated flat keys, and the
+        per-replica /trace docs must merge into one offset-aligned
+        Perfetto file with a process lane per replica."""
+        from tigerbeetle_tpu.testing.chaos import run_cluster_plane_bench
+
+        out = run_cluster_plane_bench(
+            accounts=500, batch=128, batches=12, delay_ms=40.0,
+            collect_traces=True,
+        )
+        traces = out.pop("_traces")
+        statuses = out.pop("_statuses")
+        delayed = out["delayed_replica"]
+        # Gated keys recorded, dominated by the injected delay.
+        assert out["replication_lag_p99_ms"] is not None
+        assert out["quorum_straggler_p99_ms"] is not None
+        assert out["quorum_straggler_p99_ms"] > 10.0
+        # Clear separation: the slow peer's p99 stands off the healthy
+        # peer's by at least 2x, and the straggler attribution NAMES it.
+        assert out["delayed_peer_ok_p99_ms"] > 2 * out["healthy_peer_ok_p99_ms"]
+        assert out["slow_peer"] == delayed
+        peers = out["peer_table"]
+        assert peers[str(delayed)]["quorum_straggler"] > 0
+        healthy = [
+            p for rid, p in peers.items() if int(rid) != delayed
+        ]
+        assert all(
+            p["quorum_straggler"] <= peers[str(delayed)]["quorum_straggler"]
+            for p in healthy
+        )
+        # Per-peer bus counters flowed on the primary.
+        assert peers[str(delayed)]["rx_messages"] > 0
+        # Merged cluster trace: one process lane per replica, aligned.
+        ct = _load_tool("cluster_trace")
+        merged = ct.merge_traces(traces, statuses)
+        pids = {e["pid"] for e in merged["traceEvents"] if e.get("ph") == "X"}
+        assert pids == {0, 1, 2}
+        names = {
+            e["pid"]: e["args"]["name"]
+            for e in merged["traceEvents"]
+            if e.get("name") == "process_name"
+        }
+        assert set(names) == {0, 1, 2}
+        assert "clusterAlignment" in merged
+        assert all(
+            e["ts"] >= 0 for e in merged["traceEvents"] if e.get("ph") == "X"
+        )
+
+
+# --- tools: cluster_trace offline merge ----------------------------------
+
+
+class TestClusterTraceMerge:
+    def _doc(self, perf0_us, unix0_us, events):
+        return {
+            "traceEvents": [
+                {"name": n, "ph": "X", "pid": 1, "tid": 1,
+                 "ts": t, "dur": 1.0}
+                for n, t in events
+            ],
+            "timebase": {
+                "perf_ns": int(perf0_us * 1e3),
+                "unix_ns": int(unix0_us * 1e3),
+            },
+        }
+
+    def test_same_wall_moment_aligns(self):
+        ct = _load_tool("cluster_trace")
+        # Replica 0: perf zero at wall 1_000_000 µs. Event at perf 100.
+        a = self._doc(0, 1_000_000, [("a", 100.0)])
+        # Replica 1: perf zero at wall 2_000_000 µs, and its wall clock
+        # runs 500 ms AHEAD of replica 0. Same true moment as event "a"
+        # = wall_0 1_000_100 = wall_1 1_500_100 → perf −499_900... use a
+        # later moment: wall_0 1_600_100 → wall_1 2_100_100 → perf 100_100.
+        b = self._doc(0, 2_000_000, [("b", 100_100.0)])
+        statuses = [
+            {"replica": 0, "peers": {"1": {"clock_offset_ms": 500.0}}},
+            {"replica": 1, "peers": {"0": {"clock_offset_ms": -500.0}}},
+        ]
+        merged = ct.merge_traces([a, b], statuses)
+        ts = {
+            e["name"]: e["ts"] for e in merged["traceEvents"]
+            if e.get("ph") == "X"
+        }
+        # a at wall_0 1_000_100; b at wall_1 2_100_100 − offset 500_000
+        # = wall_0 1_600_100 → 600_000 µs after a.
+        assert abs((ts["b"] - ts["a"]) - 600_000.0) < 1.0
+        assert merged["clusterAlignment"]["offsets_ms"] == {
+            "0": 0.0, "1": 500.0,
+        }
+
+    def test_fallback_to_peer_own_estimate(self):
+        ct = _load_tool("cluster_trace")
+        statuses = [
+            {"replica": 0, "peers": {}},
+            {"replica": 1, "peers": {"0": {"clock_offset_ms": -250.0}}},
+        ]
+        offs = ct.offsets_vs_reference(statuses)
+        assert offs == [0.0, 250.0]
+
+    def test_no_statuses_merges_unaligned(self):
+        ct = _load_tool("cluster_trace")
+        a = self._doc(0, 1_000_000, [("a", 1.0)])
+        b = self._doc(0, 1_000_000, [("b", 2.0)])
+        merged = ct.merge_traces([a, b])
+        pids = {e["pid"] for e in merged["traceEvents"] if e.get("ph") == "X"}
+        assert pids == {0, 1}
+
+
+# --- tools: cluster_top + trace_summary ----------------------------------
+
+
+class TestClusterTools:
+    def test_cluster_top_render(self):
+        top = _load_tool("cluster_top")
+        statuses = [
+            {
+                "replica": 0, "view": 1, "status": "normal",
+                "is_primary": 1, "op": 10, "commit_min": 10,
+                "clock": {"skew_bound_ms": 0.5},
+                "peers": {
+                    "1": {"lag_ops": 0, "prepare_ok_p50_ms": 1.0,
+                          "prepare_ok_p99_ms": 2.0, "quorum_complete": 9,
+                          "quorum_straggler": 1, "clock_offset_ms": 0.1,
+                          "rtt_ms": 0.4, "connected": 1},
+                },
+            },
+            None,
+        ]
+        text = top.render(statuses, [8081, 8082])
+        assert "UNREACHABLE" in text
+        assert "primary" in text
+        assert "0->1" in text
+
+    def test_trace_summary_peer_subrows(self, tmp_path):
+        ts = _load_tool("trace_summary")
+        dump = {
+            "reason": "test",
+            "ops": [{
+                "op": 5, "operation": 129, "n_events": 4,
+                "perceived_ms": 50.0,
+                "components": {
+                    "op.queue.request": 1.0, "op.queue.quorum": 40.0,
+                    "op.service.execute": 2.0,
+                },
+                "peer_ok_ms": {"0": 41.5, "2": 3.0},
+                "quorum_ms": 3.0, "quorum_peer": 2,
+            }],
+        }
+        p = tmp_path / "flight.json"
+        p.write_text(json.dumps(dump))
+        text = ts.summarize_ops(str(p))
+        assert "peer 0 ok" in text and "peer 2 ok" in text
+        assert "✓q" in text
+        assert "straggler" in text
+
+
+# --- bench_gate: cluster_plane keys, n/a vs BENCH_r06 ---------------------
+
+
+class TestBenchGateClusterPlane:
+    CLUSTER_PLANE = {
+        "replication_lag_p99_ms": 44.0,
+        "quorum_straggler_p99_ms": 39.8,
+    }
+
+    def _gate(self, tmp_path, monkeypatch, baseline_extra, current_extra,
+              baseline_name="BENCH_r97.json"):
+        gate = _load_tool("bench_gate")
+        (tmp_path / baseline_name).write_text(
+            json.dumps({"parsed": {"extra": baseline_extra}})
+        )
+        monkeypatch.setattr(gate, "REPO", str(tmp_path))
+        return gate.main([
+            "--current-json", json.dumps({"extra": current_extra}),
+            "--devhub", str(tmp_path / "devhub.jsonl"),
+        ])
+
+    def test_na_tolerance_vs_bench_r06(self, tmp_path, monkeypatch, capsys):
+        """The shipped BENCH_r06 baseline predates the cluster plane:
+        a candidate that RECORDS the new keys must gate n/a on them
+        (and numerically on everything else) — run against the real
+        r06 extra block so profile adoption + every other gated key
+        stay exercised."""
+        with open(os.path.join(REPO, "BENCH_r06.json")) as f:
+            r06 = json.load(f)
+        base_extra = (r06.get("parsed") or r06)["extra"]
+        cur = json.loads(json.dumps(base_extra))
+        cur["cluster_plane"] = dict(self.CLUSTER_PLANE)
+        rc = self._gate(tmp_path, monkeypatch, base_extra, cur)
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "cluster_plane.replication_lag_p99_ms" in out
+        line = next(
+            ln for ln in out.splitlines()
+            if "cluster_plane.replication_lag_p99_ms" in ln
+        )
+        assert "n/a" in line
+
+    def test_regression_fails_once_baselined(self, tmp_path, monkeypatch):
+        base = {
+            "end_to_end": {"load_accepted_tx_per_s": 1000.0},
+            "cluster_plane": dict(self.CLUSTER_PLANE),
+        }
+        cur = json.loads(json.dumps(base))
+        cur["cluster_plane"]["quorum_straggler_p99_ms"] = 60.0  # +50%
+        assert self._gate(tmp_path, monkeypatch, base, cur) == 1
+
+    def test_missing_after_baselined_fails_closed(self, tmp_path, monkeypatch):
+        base = {
+            "end_to_end": {"load_accepted_tx_per_s": 1000.0},
+            "cluster_plane": dict(self.CLUSTER_PLANE),
+        }
+        cur = {"end_to_end": {"load_accepted_tx_per_s": 1000.0}}
+        assert self._gate(tmp_path, monkeypatch, base, cur) == 1
+
+    def test_list_names_the_keys(self, capsys):
+        gate = _load_tool("bench_gate")
+        rc = gate.main(["--list"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "cluster_plane.replication_lag_p99_ms" in out
+        assert "cluster_plane.quorum_straggler_p99_ms" in out
+
+
+# --- NetFault delay_to parsing -------------------------------------------
+
+
+class TestNetFaultDelayTo:
+    def test_parse_and_filter(self):
+        from tigerbeetle_tpu.net.bus import NetFault
+
+        nf = NetFault("delay_ms=30,delay_to=1|2,seed=5")
+        assert nf.delay_s == 0.030
+        assert nf.delay_to == frozenset((1, 2))
+
+    def test_unknown_key_still_raises(self):
+        from tigerbeetle_tpu.net.bus import NetFault
+
+        with pytest.raises(ValueError, match="delay_to"):
+            NetFault("dleay_to=1")

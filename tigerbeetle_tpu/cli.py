@@ -188,9 +188,28 @@ def cmd_start(args) -> int:
             # replica's own event loop — a scrape observes the live
             # registry, no extra thread. The reference is held for the
             # server's lifetime (a dropped asyncio.Server may be GC'd).
-            metrics_server = await tracer.serve_metrics(args.metrics_port)
+            # /cluster adds this replica's cluster-plane status table
+            # (view/commit position + per-peer lag/latency/clock-offset
+            # health) for tools/cluster_top.py and the timebase +
+            # offset estimates tools/cluster_trace.py aligns merged
+            # traces with.
+            import json as _json
+
+            from tigerbeetle_tpu.vsr import peerstats
+
+            routes = {
+                "/cluster": lambda: (
+                    _json.dumps(
+                        peerstats.cluster_status(replica, server)
+                    ).encode(),
+                    "application/json",
+                ),
+            }
+            metrics_server = await tracer.serve_metrics(
+                args.metrics_port, extra=routes
+            )
             print(f"metrics on http://127.0.0.1:{args.metrics_port}/metrics "
-                  f"(trace: /trace)", flush=True)
+                  f"(trace: /trace, cluster: /cluster)", flush=True)
         print(f"replica {args.replica}/{len(addresses)} listening on {host}:{port} "
               f"(backend={args.backend}, status={replica.status})", flush=True)
         await server.serve_forever()

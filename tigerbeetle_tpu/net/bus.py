@@ -347,6 +347,9 @@ class NetFault:
                         reject the frame by header checksum
                         (`bus.rx_checksum_fail`) and reconnect clean
         delay_ms=2      jittered per-frame delay (0.5x-1.5x)
+        delay_to=1|2    restrict delay_ms to these peer replica indexes
+                        (empty/absent = all peers) — a one-slow-LINK
+                        model for the cluster-plane telemetry tests
         blackhole=1|2   peer replica indexes to isolate, both directions
         seed=7          fault RNG seed (deterministic schedules)
 
@@ -355,7 +358,9 @@ class NetFault:
     determinism suites never construct a ReplicaServer, and servers built
     without the env are byte-identical to pre-shim behavior)."""
 
-    __slots__ = ("drop", "dup", "corrupt", "delay_s", "blackhole", "rng")
+    __slots__ = (
+        "drop", "dup", "corrupt", "delay_s", "delay_to", "blackhole", "rng",
+    )
 
     def __init__(self, spec: str, seed: int = 0) -> None:
         import random as _random
@@ -364,6 +369,7 @@ class NetFault:
         self.dup = 0.0
         self.corrupt = 0.0
         self.delay_s = 0.0
+        self.delay_to: frozenset = frozenset()
         self.blackhole: frozenset = frozenset()
         for part in spec.split(","):
             part = part.strip()
@@ -378,6 +384,10 @@ class NetFault:
                 self.corrupt = float(v)
             elif k == "delay_ms":
                 self.delay_s = float(v) / 1e3
+            elif k == "delay_to":
+                self.delay_to = frozenset(
+                    int(x) for x in v.split("|") if x != ""
+                )
             elif k == "blackhole":
                 self.blackhole = frozenset(
                     int(x) for x in v.split("|") if x != ""
@@ -390,7 +400,7 @@ class NetFault:
                 raise ValueError(
                     f"TIGERBEETLE_TPU_NET_FAULT: unknown key {k!r} in "
                     f"{spec!r} (known: drop dup corrupt delay_ms "
-                    "blackhole seed)"
+                    "delay_to blackhole seed)"
                 )
         self.rng = _random.Random(seed)
 
@@ -447,6 +457,17 @@ class ReplicaServer:
         # docs/CHAOS.md). None when the env is unset: the peer send path
         # pays one `is not None` check and nothing else.
         self.net_fault: Optional[NetFault] = NetFault.from_env()
+        # Per-peer bus counter names, preformatted (the tx path runs per
+        # outbound peer frame on the loop — no f-string per message).
+        # Bounded by the address list, so the counter families are too.
+        self._peer_tx = tuple(  # tidy: owner=loop
+            (f"bus.peer.{r}.tx_messages", f"bus.peer.{r}.tx_bytes")
+            for r in range(len(addresses))
+        )
+        self._peer_rx = tuple(  # tidy: owner=loop
+            (f"bus.peer.{r}.rx_messages", f"bus.peer.{r}.rx_bytes")
+            for r in range(len(addresses))
+        )
         replica.bus = self  # inject ourselves as the bus
 
     @property
@@ -461,10 +482,33 @@ class ReplicaServer:
             return
         conn = self.peer_conns.get(r)
         if conn is not None:
+            if tracer.enabled() and r < len(self._peer_tx):
+                names = self._peer_tx[r]
+                tracer.count(names[0])
+                tracer.count(names[1], HEADER_SIZE + len(msg.body))
             if self.net_fault is not None:
                 self._send_faulted(r, conn, msg)
                 return
             conn.send_message(msg)
+
+    def _count_peer_rx(self, r: int, size: int) -> None:
+        """Per-peer ingress counters for an identified peer frame (the
+        link the frame ARRIVED on, not the originator a relayed prepare
+        names in its header)."""
+        if tracer.enabled() and 0 <= r < len(self._peer_rx):
+            names = self._peer_rx[r]
+            tracer.count(names[0])
+            tracer.count(names[1], size)
+
+    def _peer_unmapped(self, r: int) -> None:
+        """A peer connection unmapped: hand the replica the retirement
+        of that peer's gauge family + clock window (registry stays
+        size-stable across reconnect churn)."""
+        fn = getattr(self.replica, "peer_unmapped", None)
+        if fn is not None:
+            fn(r)
+        else:  # unit harnesses with stub replicas
+            tracer.remove_gauges_prefix(f"vsr.peer.{r}.")
 
     def _send_faulted(self, r: int, conn: _Conn, msg: Message) -> None:
         """Peer send through the fault shim (never on the clean path):
@@ -481,6 +525,9 @@ class ReplicaServer:
         if copies == 2:
             tracer.count("bus.fault.duplicated")
         command = int(msg.header["command"])
+        # delay_to narrows the delay to specific peer LINKS (one slow
+        # link, not a uniformly slow host) — empty means all peers.
+        delayed = nf.delay_s and (not nf.delay_to or r in nf.delay_to)
         for _ in range(copies):
             payload: Optional[bytes] = None
             if nf.corrupt and nf.rng.random() < nf.corrupt:
@@ -492,7 +539,7 @@ class ReplicaServer:
                 data[nf.rng.randrange(HEADER_SIZE)] ^= 0xA5
                 payload = bytes(data)
                 tracer.count("bus.fault.corrupted")
-            if nf.delay_s:
+            if delayed:
                 data = payload if payload is not None else msg.to_bytes()
                 tracer.count("bus.fault.delayed")
                 try:
@@ -636,10 +683,18 @@ class ReplicaServer:
             try:
                 await self._read_loop(reader, expected_replica=r)
             finally:
-                # Unmap + retire the gauge on EVERY exit (a raised
+                # Unmap + retire the gauges on EVERY exit (a raised
                 # dispatch included) so the next loop iteration
-                # reconnects against clean state.
-                self.peer_conns.pop(r, None)
+                # reconnects against clean state — but only when the
+                # mapping is OURS: full-mesh pairs run dual connections
+                # (both sides dial; PING remap is latest-wins), and
+                # dropping this outbound socket while the peer's
+                # inbound connection owns the mapping must neither
+                # blank the healthy send route nor retire the peer's
+                # clock window and gauges.
+                if self.peer_conns.get(r) is conn:
+                    self.peer_conns.pop(r, None)
+                    self._peer_unmapped(r)
                 conn.close_gauge()
 
     # Receive-side stall poll cadence: one tick — the drain rate is
@@ -752,6 +807,8 @@ class ReplicaServer:
             ):
                 tracer.count("bus.fault.blackholed")
                 continue
+            if peer_replica is not None:
+                self._count_peer_rx(peer_replica, int(h["size"]))
             self._dispatch(msg)
             if (
                 cmd == Command.REQUEST and h["client"] != 0
@@ -784,6 +841,7 @@ class ReplicaServer:
             tracer.gauge("bus.client_conns", len(self.client_conns))
         if peer_replica is not None and self.peer_conns.get(peer_replica) is conn:
             del self.peer_conns[peer_replica]
+            self._peer_unmapped(peer_replica)
         conn.close_gauge()
         writer.close()
 
@@ -800,4 +858,7 @@ class ReplicaServer:
                 ):
                     tracer.count("bus.fault.blackholed")
                     continue
+                self._count_peer_rx(
+                    expected_replica, int(msg.header["size"])
+                )
                 self._dispatch(msg)

@@ -656,6 +656,23 @@ def scenario_primary_kill(
     `degraded_throughput_pct`; the client-perceived blackout p99 comes
     from per-request arrival stamps. recovery_time_s is the full window
     to restored redundancy (old primary restarted and caught up)."""
+    from tigerbeetle_tpu import tracer
+
+    # Per-peer attribution needs the registry; restore the prior state
+    # on EVERY exit (a timed-out election included) so a disabled-path
+    # test after us stays disabled.
+    tracer_was_enabled = tracer.enabled()
+    tracer.enable()
+    try:
+        return _primary_kill_body(seed, base_s, timeout_s)
+    finally:
+        if not tracer_was_enabled:
+            tracer.disable()
+
+
+def _primary_kill_body(
+    seed: int, base_s: float, timeout_s: float,
+) -> ScenarioResult:
     h = ChaosHarness(seed=seed)
     cl = h.cluster
     h.drive_until(lambda: h.tip() >= 8, timeout_s)
@@ -663,6 +680,9 @@ def scenario_primary_kill(
     el, ops = h.drive(base_s)
     baseline = h.rate(el, ops)
 
+    # Cluster-plane snapshot BEFORE the kill: the election report pairs
+    # it with the after-snapshot so the slow peer has a name.
+    peer_before = peer_telemetry_snapshot()
     primary = h.primary_of_view()
     view_before = max(r.view for r in cl.replicas if r is not None)
     t_fault = time.perf_counter()
@@ -699,6 +719,14 @@ def scenario_primary_kill(
     h.drive_until(rejoined, timeout_s)
     t_rejoin = time.perf_counter()
     degraded = h.rate(t_rejoin - t_fault, h.tip() - tip_at_fault)
+    # Cluster-plane snapshot AFTER rejoin: the before/after pair plus
+    # the new primary's in-process peer table name the slow/dead peer
+    # in the election report (docs/CHAOS.md).
+    peer_after = peer_telemetry_snapshot()
+    from tigerbeetle_tpu.vsr.peerstats import cluster_status
+
+    new_primary_peers = cluster_status(new_primary).get("peers", {})
+    slow = slowest_peer({"peers": new_primary_peers})
     res = ScenarioResult(
         name="primary_kill",
         recovery_time_s=t_rejoin - t_fault,
@@ -718,7 +746,10 @@ def scenario_primary_kill(
             "vc_svc_wait_s": float(vc.get("svc_wait_s", 0.0)),
             "vc_dvc_collect_s": float(vc.get("dvc_collect_s", 0.0)),
             "vc_sv_replay_s": float(vc.get("sv_replay_s", 0.0)),
-        },
+            "peer_telemetry_before": peer_before,
+            "peer_telemetry_after": peer_after,
+            "peer_table": new_primary_peers,
+        } | ({"slow_peer": float(slow)} if slow is not None else {}),
     )
     res.determinism = h.finish()
     return res
@@ -913,24 +944,9 @@ def scenario_partition_primary(
 
 
 def _http_get_text(port: int, path: str, timeout: float = 10.0) -> str:
-    import socket
+    from tigerbeetle_tpu.net.scrape import http_get_text
 
-    with socket.create_connection(("127.0.0.1", port), timeout=timeout) as s:
-        s.settimeout(timeout)
-        s.sendall(
-            f"GET {path} HTTP/1.1\r\nHost: chaos\r\nConnection: close\r\n\r\n"
-            .encode()
-        )
-        buf = b""
-        while True:
-            chunk = s.recv(1 << 16)
-            if not chunk:
-                break
-            buf += chunk
-    head, _, body = buf.partition(b"\r\n\r\n")
-    if not head.startswith(b"HTTP/1.1 200"):
-        raise IOError(f"scrape {path}: {head[:64]!r}")
-    return body.decode("utf-8", "replace")
+    return http_get_text(port, path, timeout)
 
 
 def scrape_gauges(mport: int, prefix: str = "vsr.") -> Dict[str, float]:
@@ -956,11 +972,57 @@ def scrape_recovery_gauges(mport: int) -> Dict[str, float]:
     return scrape_gauges(mport, prefix="vsr.recovery")
 
 
+def scrape_cluster_status(mport: int) -> dict:
+    """A replica's /cluster document (vsr/peerstats.cluster_status):
+    view/commit position + the per-peer health table — the failover
+    scenarios snapshot it before/after a kill so the election report
+    NAMES the slow peer instead of gesturing at a quorum wait."""
+    import json as _json
+
+    return _json.loads(_http_get_text(mport, "/cluster"))
+
+
+def slowest_peer(status: dict) -> Optional[int]:
+    """The peer index with the worst prepare_ok p99 in a /cluster
+    document (None when no peer has samples)."""
+    worst, worst_p99 = None, -1.0
+    for rid, p in status.get("peers", {}).items():
+        p99 = p.get("prepare_ok_p99_ms")
+        if p99 is not None and p99 > worst_p99:
+            worst, worst_p99 = int(rid), p99
+    return worst
+
+
+def peer_telemetry_snapshot() -> Dict[str, float]:
+    """Per-peer replication telemetry from the IN-PROCESS tracer
+    registry (the process twin scrapes /cluster instead): prepare_ok
+    p99/count per peer, quorum attribution counters, and the per-peer
+    gauges. In-process clusters share one registry, so counters
+    aggregate across every replica that served as primary — the
+    before/after DELTA around a fault is the per-episode view."""
+    from tigerbeetle_tpu import tracer
+
+    out: Dict[str, float] = {}
+    for name, row in tracer.snapshot().items():
+        if not name.startswith("vsr.peer."):
+            continue
+        if "p50_us" in row:
+            out[f"{name}.p99_ms"] = round(row.get("p99_us", 0.0) / 1e3, 3)
+            out[f"{name}.count"] = float(row.get("count", 0))
+        else:
+            out[name] = float(row.get("count", 0))
+    for name, v in tracer.gauges().items():
+        if name.startswith("vsr.peer.") or name.startswith("vsr.clock."):
+            out[name] = v
+    return out
+
+
 def _spawn_replica(
     path: str, port: int, mport: int, config: str, backend: str,
     extra_args: Sequence[str] = (),
     addresses: Optional[str] = None,
     replica: int = 0,
+    env: Optional[Dict[str, str]] = None,
 ) -> "object":
     """Start `cli.py start` detached; returns the Popen once the replica
     announces its listener (after open(), i.e. after WAL replay — or at
@@ -969,7 +1031,9 @@ def _spawn_replica(
     block on a full pipe mid-scenario. `extra_args` rides extra cli.py
     start flags (the front-door loadgen passes --clients-max etc.).
     `addresses`/`replica` spawn one member of a multi-replica cluster
-    (default: a single replica on its own port)."""
+    (default: a single replica on its own port). `env` overlays extra
+    environment on the child (per-replica fault injection: ONE replica
+    started under TIGERBEETLE_TPU_NET_FAULT models one degraded host)."""
     import subprocess
     import sys
     import threading
@@ -984,6 +1048,7 @@ def _spawn_replica(
             f"--metrics-port={mport}", *extra_args, path,
         ],
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        env={**os.environ, **env} if env else None,
     )
     for _ in range(256):  # boot chatter (warnings, logging) before the announce
         line = proc.stdout.readline()
@@ -999,6 +1064,7 @@ def spawn_cluster(
     config: str = "development",
     backend: str = "numpy",
     extra_args: Sequence[str] = (),
+    env_overrides: Optional[Dict[int, Dict[str, str]]] = None,
 ) -> Tuple[list, list, list, list]:
     """Format + start a REAL `cli.py start` cluster over TCP: one data
     file and one process per replica, a shared --addresses list, and a
@@ -1029,6 +1095,7 @@ def spawn_cluster(
         procs.append(_spawn_replica(
             paths[i], ports[i], mports[i], config, backend,
             extra_args=extra_args, addresses=addresses, replica=i,
+            env=(env_overrides or {}).get(i),
         ))
     return procs, ports, mports, paths
 
@@ -1301,6 +1368,14 @@ def scenario_primary_kill_process(
             accepted_load0 = lg.stats.accepted_tx
             time.sleep(1.0)  # a steady pre-kill window
 
+            # Cluster-plane snapshot BEFORE the kill: the doomed
+            # primary's per-peer table (lag, prepare_ok p99, quorum
+            # attribution, clock offsets) from its /cluster endpoint.
+            try:
+                peers_before = scrape_cluster_status(mports[primary])
+            except (OSError, ValueError):
+                peers_before = {}
+
             # SIGKILL the process-level primary mid-load.
             acked_pre_kill = list(lg.stats.acked_sample)
             accepted_pre_kill = lg.stats.accepted_tx
@@ -1427,6 +1502,20 @@ def scenario_primary_kill_process(
                     ),
                 },
             )
+            # Cluster-plane snapshots around the kill: the old primary's
+            # pre-kill peer table and the NEW primary's post-election
+            # table — the election report names the slow/dead peer (the
+            # killed replica shows up as the new primary's laggard until
+            # its restart catches up).
+            try:
+                peers_after = scrape_cluster_status(mports[new_primary])
+            except (OSError, ValueError):
+                peers_after = {}
+            res.extra["peer_telemetry_before"] = peers_before.get("peers", {})
+            res.extra["peer_telemetry_after"] = peers_after.get("peers", {})
+            slow = slowest_peer(peers_after)
+            if slow is not None:
+                res.extra["slow_peer"] = float(slow)
             return res
         finally:
             for p in [*procs, proc_restart]:
@@ -1481,3 +1570,174 @@ def run_all(
         proc["sim"] = sim
         out["kill_restart"] = proc
     return out
+
+
+# --- cluster-plane bench (bench.py `cluster_plane` section) ---------------
+
+
+def run_cluster_plane_bench(
+    accounts: int = 2000,
+    batch: int = 512,
+    batches: int = 40,
+    delay_ms: float = 30.0,
+    delayed_replica: int = 2,
+    config: str = "development",
+    backend: str = "numpy",
+    timeout_s: float = 120.0,
+    collect_traces: bool = False,
+) -> dict:
+    """The cluster-plane objectives as a benchmark: a REAL 3 ×
+    `cli.py start` TCP cluster with ONE NetFault-delayed backup (its
+    outbound peer frames — prepare_oks included — ride
+    TIGERBEETLE_TPU_NET_FAULT delay_ms), batched transfers driven at
+    the primary, then the primary's scrape surface read back:
+
+      replication_lag_p99_ms    broadcast → prepare_ok arrival over
+                                every remote ack (/lifecycle flat)
+      quorum_straggler_p99_ms   q-th arrival → straggler arrival
+                                overhang (/lifecycle flat)
+
+    Both gated by tools/bench_gate.py (>10% rule, n/a vs
+    pre-cluster-plane baselines, MISSING fails closed). The injected
+    delay dominates both distributions, so the numbers are stable
+    across hosts — a regression means the telemetry or the replication
+    plane changed, not the weather. The per-peer separation (delayed
+    backup's prepare_ok p99 vs the healthy peer's) and the straggler
+    attribution naming it ride along as recorded (ungated) evidence.
+
+    Fault topology: the delay is injected AFTER the first election by
+    restarting one backup under `delay_ms=…,delay_to=<primary>` — only
+    that backup's frames TO the primary (prepare_oks, pongs) lag. A
+    blanket outbound delay would also slow its chain-FORWARDED prepares
+    and smear the injected latency onto the downstream peer's acks,
+    which is exactly the ambiguity per-peer attribution exists to
+    remove. `delayed_replica` is ignored when it would be the primary
+    (a backup is picked relative to the elected primary)."""
+    import json as _json
+    import tempfile
+
+    import numpy as np
+
+    from tigerbeetle_tpu import types
+    from tigerbeetle_tpu.client import Client
+
+    t_section = time.perf_counter()
+    with tempfile.TemporaryDirectory(prefix="tbtpu-clusterplane-") as tmp:
+        procs, ports, mports, paths = spawn_cluster(
+            tmp, replica_count=3, config=config, backend=backend,
+        )
+        try:
+            primary, view, _ = wait_cluster_primary(mports, timeout_s)
+            if delayed_replica == primary:
+                delayed_replica = (primary + 1) % 3
+            fault_env = {
+                "TIGERBEETLE_TPU_NET_FAULT": (
+                    f"delay_ms={delay_ms:g},delay_to={primary},seed=7"
+                ),
+            }
+            # Restart the chosen backup under the one-slow-LINK fault
+            # (a backup restart needs no election: quorum holds on the
+            # other two while it replays + rejoins).
+            procs[delayed_replica].kill()
+            procs[delayed_replica].wait()
+            addresses_str = ",".join(f"127.0.0.1:{p}" for p in ports)
+            procs[delayed_replica] = _spawn_replica(
+                paths[delayed_replica], ports[delayed_replica],
+                mports[delayed_replica], config, backend,
+                addresses=addresses_str, replica=delayed_replica,
+                env=fault_env,
+            )
+            deadline = time.perf_counter() + timeout_s
+            rejoined = False
+            while time.perf_counter() < deadline:
+                try:
+                    g = scrape_gauges(mports[delayed_replica], prefix="vsr.")
+                except (OSError, ValueError):
+                    time.sleep(0.1)
+                    continue
+                if g.get("vsr.recovery_state", -1.0) == 0.0:
+                    rejoined = True
+                    break
+                time.sleep(0.1)
+            assert rejoined, "delayed backup never rejoined after restart"
+
+            client = Client([("127.0.0.1", ports[primary])])
+            ev = np.zeros(accounts, dtype=types.ACCOUNT_DTYPE)
+            ev["id_lo"] = np.arange(1, accounts + 1, dtype=np.uint64)
+            ev["ledger"] = 1
+            ev["code"] = 10
+            client.create_accounts(ev)
+            rng = np.random.default_rng(0xC1A0)
+            next_id = 1
+            t_load = time.perf_counter()
+            for _ in range(batches):
+                tr = np.zeros(batch, dtype=types.TRANSFER_DTYPE)
+                tr["id_lo"] = np.arange(
+                    next_id, next_id + batch, dtype=np.uint64
+                )
+                next_id += batch
+                dr = rng.integers(1, accounts + 1, batch).astype(np.uint64)
+                cr = rng.integers(1, accounts + 1, batch).astype(np.uint64)
+                cr = np.where(cr == dr, (cr % accounts) + 1, cr)
+                tr["debit_account_id_lo"] = dr
+                tr["credit_account_id_lo"] = cr
+                tr["amount_lo"] = 1
+                tr["ledger"] = 1
+                tr["code"] = 7
+                res = client.create_transfers(tr)
+                assert len(res) == 0, f"transfer batch rejected: {res[:4]}"
+            load_s = time.perf_counter() - t_load
+
+            lc = _json.loads(_http_get_text(mports[primary], "/lifecycle"))
+            flat = lc.get("flat", {})
+            status = scrape_cluster_status(mports[primary])
+            peers = status.get("peers", {})
+            delayed = peers.get(str(delayed_replica), {})
+            healthy_p99 = [
+                p.get("prepare_ok_p99_ms", 0.0)
+                for rid, p in peers.items()
+                if int(rid) != delayed_replica
+                and p.get("prepare_ok_p99_ms") is not None
+            ]
+            out = {
+                "replication_lag_p99_ms": flat.get("replication_lag_p99_ms"),
+                "quorum_straggler_p99_ms": flat.get(
+                    "quorum_straggler_p99_ms"
+                ),
+                "replication_lag_p50_ms": flat.get("replication_lag_p50_ms"),
+                "quorum_straggler_p50_ms": flat.get(
+                    "quorum_straggler_p50_ms"
+                ),
+                "delayed_replica": delayed_replica,
+                "delay_ms": delay_ms,
+                "primary": primary,
+                "peer_table": peers,
+                "delayed_peer_ok_p99_ms": delayed.get("prepare_ok_p99_ms"),
+                "healthy_peer_ok_p99_ms": (
+                    max(healthy_p99) if healthy_p99 else None
+                ),
+                "slow_peer": slowest_peer(status),
+                "tx_per_s": round(batches * batch / max(load_s, 1e-9), 1),
+                "section_wall_s": round(
+                    time.perf_counter() - t_section, 1
+                ),
+            }
+            if "clock" in status:
+                out["skew_bound_ms"] = status["clock"].get("skew_bound_ms")
+            if collect_traces:
+                # Test hook (not on the bench path): every replica's
+                # /trace + /cluster docs while still live, for the
+                # merged-Perfetto assertion (tools/cluster_trace.py).
+                out["_traces"] = [
+                    _json.loads(_http_get_text(mports[i], "/trace"))
+                    for i in range(3)
+                ]
+                out["_statuses"] = [
+                    scrape_cluster_status(mports[i]) for i in range(3)
+                ]
+            return out
+        finally:
+            for p in procs:
+                if p is not None and p.poll() is None:
+                    p.kill()
+                    p.wait()

@@ -326,6 +326,19 @@ def remove_gauge(name: str) -> None:
         _gauges.pop(name, None)
 
 
+def remove_gauges_prefix(prefix: str) -> None:
+    """Retire every gauge under a name prefix — the per-peer families
+    (`vsr.peer.<r>.*`) when a peer connection unmaps: a dead peer must
+    not keep serving stale offset/lag values on every scrape, and the
+    registry must stay size-stable across connection churn (the same
+    leak class as the per-conn send-queue gauges)."""
+    if not _enabled:
+        return
+    with _registry_lock:
+        for name in [n for n in _gauges if n.startswith(prefix)]:
+            del _gauges[name]
+
+
 def gauges() -> Dict[str, float]:
     with _registry_lock:
         return dict(_gauges)
@@ -377,6 +390,17 @@ OP_STORE_COMPONENTS = (
 )
 _OP_ZEROS = bytes(8 * OP_STAMPS)
 
+# Per-peer prepare_ok arrival stamps (cluster-plane telemetry,
+# docs/OBSERVABILITY.md): slot index = acking replica index. Active
+# replica counts are ≤ 6 (reference constants.zig); 8 keeps the array
+# power-of-two and leaves headroom. Stamped by vsr/peerstats.py on the
+# primary's loop thread with the same discipline as the lifecycle
+# stamps: the record travels with the op, each slot is written by
+# exactly one thread at a known hand-off, partial records on view
+# change are closed, never fabricated.
+OP_PEER_MAX = 8
+_PEER_ZEROS = bytes(8 * OP_PEER_MAX)
+
 
 class OpRecord:
     """One prepare's lifecycle: identity + stamp array. Pooled — reset()
@@ -384,11 +408,13 @@ class OpRecord:
 
     __slots__ = (
         "op", "client", "request", "operation", "n_events", "t", "done",
-        "released",
+        "released", "peer_t", "peer_bcast", "quorum_t", "quorum_peer",
+        "peers_open", "ring_evicted",
     )
 
     def __init__(self) -> None:
         self.t = array("q", _OP_ZEROS)
+        self.peer_t = array("q", _PEER_ZEROS)
         self.reset()
 
     def reset(self) -> None:
@@ -402,9 +428,28 @@ class OpRecord:
         # so an eviction may recycle it (see op_finish). Fault-dropped
         # records are never released and fall to the GC instead.
         self.released = False
+        # Cluster-plane stamps (vsr/peerstats.py, primary only):
+        # broadcast time, per-peer prepare_ok arrivals, the q-th arrival
+        # that completed the quorum and which peer it came from.
+        # peers_open: the primary's straggler tracker still holds the
+        # record (a post-quorum ack may yet stamp it) — eviction must
+        # not recycle it until the tracker lets go.
+        self.peer_bcast = 0
+        self.quorum_t = 0
+        self.quorum_peer = -1
+        self.peers_open = False
+        # The flight ring evicted this record while its peer window was
+        # still open (a down peer holds windows open for TRACK_MAX ops,
+        # past the ring's eviction horizon): op_peer_release re-offers
+        # it to the pool once the tracker lets go, so a degraded period
+        # — exactly when the plane matters — stays allocation-free.
+        self.ring_evicted = False
         t = self.t
         for i in range(OP_STAMPS):
             t[i] = 0
+        pt = self.peer_t
+        for i in range(OP_PEER_MAX):
+            pt[i] = 0
 
 
 OP_RING_DEFAULT = 128  # completed records retained for the flight dump
@@ -562,10 +607,21 @@ def op_finish(rec: Optional[OpRecord]) -> None:
             # the GC — a trailing stamp into a reset record would
             # corrupt a fresh op.
             et = evicted.t
-            if evicted.released and (
-                not et[OP_WAL_ENQUEUE] or et[OP_WAL_DURABLE]
+            if (
+                evicted.released
+                and not evicted.peers_open
+                and (not et[OP_WAL_ENQUEUE] or et[OP_WAL_DURABLE])
             ):
+                # peers_open: the primary's straggler tracker
+                # (vsr/peerstats.py) may still stamp a late prepare_ok
+                # into peer_t — recycling would let that trailing stamp
+                # corrupt a fresh op. Such records are marked instead
+                # and re-offered by op_peer_release when the tracker
+                # lets go (a down peer would otherwise starve the pool
+                # for its whole outage).
                 _op_pool.append(evicted)
+            else:
+                evicted.ring_evicted = True
         _op_ring.append(rec)
         if perceived > 0:
             if _op_window[2] >= _flight["min_ops"]:
@@ -610,6 +666,24 @@ def op_store_done(rec: Optional[OpRecord]) -> None:
     rec.released = True
 
 
+def op_peer_release(rec: Optional[OpRecord]) -> None:
+    """The peer tracker (vsr/peerstats.py) let go of a record. If the
+    flight ring already evicted it while the window was open (a down
+    peer holds windows for TRACK_MAX ops, past the ring horizon), pool
+    it now — provided every OTHER holder is also done (same conditions
+    as the eviction path); otherwise it falls to the GC as before."""
+    if rec is None:
+        return
+    rec.peers_open = False
+    if not rec.ring_evicted:
+        return  # still in the ring; eviction will pool it
+    t = rec.t
+    if rec.released and (not t[OP_WAL_ENQUEUE] or t[OP_WAL_DURABLE]):
+        rec.ring_evicted = False
+        with _registry_lock:
+            _op_pool.append(rec)
+
+
 def op_record_dict(rec: OpRecord) -> dict:
     """JSON-ready view of one lifecycle record: raw stamps share the
     perf_counter timebase with trace_events(), so a flight dump and its
@@ -629,6 +703,22 @@ def op_record_dict(rec: OpRecord) -> dict:
     }
     if t[OP_REPLY] and t[OP_ARRIVE]:
         out["perceived_ms"] = round((t[OP_REPLY] - t[OP_ARRIVE]) / 1e6, 3)
+    if rec.peer_bcast:
+        # Cluster-plane sub-rows (primary-proposed prepares only): each
+        # peer's prepare_ok arrival relative to the broadcast, plus the
+        # quorum point — trace_summary --ops renders these under the
+        # queue.quorum component so a straggling link is visible in a
+        # flight dump.
+        oks = {
+            str(r): round((rec.peer_t[r] - rec.peer_bcast) / 1e6, 3)
+            for r in range(OP_PEER_MAX) if rec.peer_t[r]
+        }
+        if oks:
+            out["peer_ok_ms"] = oks
+        if rec.quorum_t:
+            out["quorum_ms"] = round((rec.quorum_t - rec.peer_bcast) / 1e6, 3)
+            if rec.quorum_peer >= 0:
+                out["quorum_peer"] = rec.quorum_peer
     return out
 
 
@@ -855,6 +945,21 @@ def lifecycle_summary() -> dict:
         flat[key] = s["mean_ms"]
         flat[f"{key}_p50"] = s["p50_ms"]
         flat[f"{key}_p99"] = s["p99_ms"]
+    # Cluster-plane replication rows (vsr/peerstats.py, primary only;
+    # absent on single-replica runs): broadcast→prepare_ok arrival over
+    # every REMOTE peer ack (replication lag as a latency distribution)
+    # and the quorum→straggler-arrival overhang. The *_p99_ms keys are
+    # gated by tools/bench_gate.py (cluster_plane section, >10% rule).
+    for event, key in (
+        ("vsr.replication.lag", "replication_lag"),
+        ("vsr.quorum.straggler", "quorum_straggler"),
+    ):
+        s = stats(event)
+        if s is None:
+            continue
+        flat[f"{key}_ms"] = s["mean_ms"]
+        flat[f"{key}_p50_ms"] = s["p50_ms"]
+        flat[f"{key}_p99_ms"] = s["p99_ms"]
     # Cross-batch commit-window occupancy (vsr/replica.py
     # _stage_note_inflight): one raw-depth sample per processed batch —
     # mean in-flight dispatched batches, the high-water, and the p99 of
@@ -1107,7 +1212,20 @@ def export_trace() -> dict:
             "name": event, "cat": "tbtpu", "ph": "X", "pid": pid,
             "tid": tid, "ts": t0 / 1e3, "dur": (t1 - t0) / 1e3,
         })
-    return {"traceEvents": evs, "displayTimeUnit": "ms"}
+    # Timebase anchor: span timestamps are perf_counter_ns (process-
+    # local). Pairing one perf reading with the wall clock lets
+    # tools/cluster_trace.py map every event onto a shared wall
+    # timeline and merge traces from separate replica processes
+    # (Perfetto ignores unknown top-level keys).
+    return {
+        "traceEvents": evs,
+        "displayTimeUnit": "ms",
+        "timebase": {
+            "perf_ns": time.perf_counter_ns(),
+            "unix_ns": time.time_ns(),
+            "pid": pid,
+        },
+    }
 
 
 def dump(path: Optional[str] = None) -> str:
@@ -1186,12 +1304,15 @@ def prometheus_text() -> str:
     return "\n".join(lines) + "\n"
 
 
-async def serve_metrics(port: int, host: str = "127.0.0.1"):
+async def serve_metrics(port: int, host: str = "127.0.0.1", extra=None):
     """Serve GET /metrics (Prometheus text) and /trace (Perfetto JSON)
     on the current asyncio loop; returns the asyncio.Server. Wired by
     `cli.py start --metrics-port` onto the replica's own event loop —
     a scrape shares the loop, so it observes the live registry with no
-    extra thread."""
+    extra thread. `extra` adds caller-owned routes: {path_prefix:
+    callable() -> (body_bytes, content_type)} — cli.py mounts /cluster
+    (the replica's cluster-plane status, vsr/peerstats.cluster_status)
+    there, keeping replica state out of this module."""
     import asyncio
 
     async def _handle(reader, writer) -> None:
@@ -1225,8 +1346,18 @@ async def serve_metrics(port: int, host: str = "127.0.0.1"):
             elif path.startswith("/flight"):
                 body = json.dumps({"ops": flight_records()}).encode()
                 ctype = "application/json"
+            elif extra is not None and any(
+                path.startswith(p) for p in extra
+            ):
+                fn = next(extra[p] for p in extra if path.startswith(p))
+                body, ctype = fn()
             else:
-                body = b"tigerbeetle-tpu observability: /metrics /trace /lifecycle /flight\n"
+                routes = "/metrics /trace /lifecycle /flight" + (
+                    " " + " ".join(sorted(extra)) if extra else ""
+                )
+                body = (
+                    f"tigerbeetle-tpu observability: {routes}\n".encode()
+                )
                 ctype = "text/plain; charset=utf-8"
                 status = "404 Not Found" if path != "/" else "200 OK"
             writer.write(

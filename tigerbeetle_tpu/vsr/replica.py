@@ -38,6 +38,8 @@ from tigerbeetle_tpu.models.state_machine import StateMachine
 from tigerbeetle_tpu.vsr import header as hdr
 from tigerbeetle_tpu.vsr import snapshot
 from tigerbeetle_tpu.vsr.clock import Clock, DeterministicTime
+from tigerbeetle_tpu.vsr.clocksync import ClockSync
+from tigerbeetle_tpu.vsr.peerstats import PeerStats
 from tigerbeetle_tpu.vsr.header import (
     Command, Header, Message, Operation, RECONFIGURE_DTYPE,
 )
@@ -293,6 +295,15 @@ class Replica:
         # offset samples; DeterministicTime keeps simulations reproducible).
         self.time = time if time is not None else DeterministicTime()
         self.clock = Clock(self.time, replica_count, replica_index)
+        # Cluster-plane telemetry (docs/OBSERVABILITY.md "cluster
+        # plane"): per-peer replication stamps + quorum attribution on
+        # the primary, and the telemetry half of clock estimation over
+        # the same ping/pong samples the state-machine clock already
+        # learns from. Pure observability — neither is read by any
+        # commit/prepare path, and the telemetry-on-vs-off determinism
+        # guard proves replicated bytes are identical either way.
+        self.peer_stats = PeerStats(replica_index, replica_count)  # tidy: owner=loop
+        self.clocksync = ClockSync(replica_index, replica_count)  # tidy: owner=loop
 
         # Timestamp high-water of COMMITTED prepares only: checkpoints must
         # capture replicated state, and the primary's sm.prepare_timestamp
@@ -755,6 +766,17 @@ class Replica:
             views = [v for v, _ in self._recovery_pongs.values()]
             self._vote_view_change(max([self.view, *views]) + 1)
 
+    def peer_unmapped(self, replica: int) -> None:
+        """A peer connection unmapped (net/bus.py): retire that peer's
+        gauge family (`vsr.peer.<r>.*` — replication lag, clock offset,
+        RTT) and drop its clock sample window. The registry must stay
+        size-stable across connection churn — a dead peer serving stale
+        gauges on every scrape is the same leak class as the round-9
+        per-conn send-queue gauges. Counters and histograms are keyed by
+        replica index (bounded) and keep their history."""
+        self.clocksync.retire(replica)
+        tracer.remove_gauges_prefix(f"vsr.peer.{replica}.")
+
     # ------------------------------------------------------------------
     # message dispatch
 
@@ -820,10 +842,20 @@ class Replica:
 
     def on_pong(self, msg: Message) -> None:
         h = msg.header
+        m1 = self.time.monotonic_ns()
         self.clock.learn(
             int(h["replica"]), m0=int(h["op"]), t_remote=int(h["timestamp"]),
-            m1=self.time.monotonic_ns(),
+            m1=m1,
         )
+        if tracer.enabled():
+            # Telemetry half of the same sample (vsr/clocksync.py):
+            # per-peer offset/RTT gauges + the cluster skew bound.
+            # Estimation only — never feeds the state machine.
+            self.clocksync.learn(
+                int(h["replica"]), m0=int(h["op"]),
+                t_remote=int(h["timestamp"]), m1=m1,
+                realtime_ns=self.time.realtime_ns(), monotonic_ns=m1,
+            )
         if self.status != STATUS_RECOVERING:
             return
         self._recovery_pongs[h["replica"]] = (h["view"], h["request"] == 1)
@@ -1060,9 +1092,15 @@ class Replica:
         )
         entry = Pipeline(prepare)
         self.pipeline.append(entry)
+        # Cluster plane: open the op's peer window at broadcast (lc is
+        # None when tracing is off — the whole plane then costs this one
+        # None check per prepare).
+        if lc is not None:
+            self.peer_stats.broadcast(self.op, lc)
         if self.wal_writer is None:
             self.journal.write_prepare(prepare, lc=lc)
             entry.ok_from.add(self.replica)
+            self._peer_ack(self.op, self.replica)
             self._replicate_chain(prepare)
             self._check_pipeline_quorum()
         else:
@@ -1090,6 +1128,11 @@ class Replica:
             or view != self.view
         ):
             return
+        # Stamp BEFORE the pipeline scan (like on_prepare_ok): when both
+        # backups acked first, quorum already popped the entry — and a
+        # local group-fsync landing AFTER the remote quorum is exactly
+        # the self-straggler the attribution exists to diagnose.
+        self._peer_ack(op, self.replica)
         for entry in self.pipeline:
             h = entry.message.header
             if h["op"] == op and h["checksum"] == checksum:
@@ -1255,12 +1298,23 @@ class Replica:
         )
         self.bus.send_to_replica(self.primary_index(self.view), Message(ok).seal())
 
+    def _peer_ack(self, op: int, replica: int) -> None:
+        """Cluster-plane ack stamp (vsr/peerstats.py): per-peer
+        prepare_ok latency, quorum completion/straggler attribution,
+        and the per-peer acked-op high-water. Telemetry only."""
+        if tracer.enabled():
+            self.peer_stats.ack(op, replica, self.quorum_replication)
+
     def on_prepare_ok(self, msg: Message) -> None:
         if not self.is_primary or msg.header["view"] != self.view:
             return
         if msg.header["epoch"] < self.slot_epoch.get(int(msg.header["replica"]), 0):
             return  # stale occupant of a reassigned slot: no quorum weight
         op = msg.header["op"]
+        # Stamp BEFORE the pipeline scan: a straggler's ack arrives
+        # after quorum already popped the entry, and attributing exactly
+        # those arrivals is the point (the tracker validates op).
+        self._peer_ack(int(op), int(msg.header["replica"]))
         for entry in self.pipeline:
             if entry.message.header["op"] == op:
                 if msg.header["parent"] == entry.message.header["checksum"]:
@@ -1349,6 +1403,11 @@ class Replica:
             tracer.gauge("vsr.pipeline.depth", len(self.pipeline))
             tracer.gauge("vsr.request_queue.depth", len(self.request_queue))
             tracer.gauge("vsr.stage.depth", len(self._staged))
+            # Per-peer replication-lag gauges, re-sampled per commit
+            # round: primary tip vs each peer's highest acked op
+            # (primary only — a backup's ack table is stale zeros).
+            if self.is_primary:
+                self.peer_stats.commit_sample(self.op, self.commit_min)
 
     def _send_commit_heartbeat(self) -> None:
         self.last_commit_sent_tick = self.tick_count
@@ -2662,6 +2721,9 @@ class Replica:
             self._vc_t0 = _time.perf_counter()  # tidy: allow=wall-clock — view-change observability only, never reaches replicated state
             self.view_change_stats = {}
         self._vc_dvc_t = None
+        # Leaving normal status: close every partial peer window —
+        # whatever per-peer stamps landed stay, nothing is fabricated.
+        self.peer_stats.close_all()
         self.status = STATUS_VIEW_CHANGE
         self.view = max(self.view, new_view)
         tracer.gauge("vsr.view", self.view)
@@ -2848,6 +2910,7 @@ class Replica:
         self.status = STATUS_NORMAL
         self.log_view = v
         self.pipeline = []
+        self.peer_stats.close_all()  # fresh peer windows for the new view
         self.request_queue = deque()
         self._queued_req = {}
         # Session-judgement floor: ops inherited from the previous view may
@@ -2984,6 +3047,9 @@ class Replica:
         self.view = v
         self.log_view = v
         self.status = STATUS_NORMAL
+        # A deposed primary lands here directly (catch-up without a
+        # local view_change episode): close its stale peer windows.
+        self.peer_stats.close_all()
         tracer.gauge("vsr.view", self.view)
         tracer.gauge("vsr.is_primary", int(self.primary_index(v) == self.replica))
         self._recovery_pongs = {}

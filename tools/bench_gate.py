@@ -5,10 +5,14 @@ p50/p99, the lifecycle queue-wait/service totals, the commit-window
 occupancy commit_inflight_mean) or the LSM store
 metrics (config5 ingest / major-compaction rates), the recovery-time
 objectives (per-scenario recovery_time_s / degraded_throughput_pct from
-the chaos-at-load section — docs/CHAOS.md), or the front-door overload
+the chaos-at-load section — docs/CHAOS.md), the front-door overload
 objectives (accepted throughput + perceived p99 at the 1x saturation
-point of the open-loop curve — docs/FRONT_DOOR.md). Lifecycle/recovery/
-overload metrics absent from an older baseline are n/a, not failures;
+point of the open-loop curve — docs/FRONT_DOOR.md), or the
+cluster-plane objectives (replication-lag and quorum-straggler p99 on
+a 3-process cluster with one delayed backup link —
+docs/OBSERVABILITY.md). Lifecycle/recovery/
+overload/cluster-plane metrics absent from an older baseline are n/a,
+not failures;
 occupancy is recorded but not gated (throughput × latency has no
 monotone-good direction).
 Steady-state jit compile counts (`steady_compiles`, recorded per device
@@ -152,6 +156,20 @@ GATED = (
     # crashed overload run records no gated keys → MISSING → fail-closed.
     ("overload", "accepted_tx_per_s_at_1x", True),
     ("overload", "perceived_p99_ms_at_1x", False),
+    # Cluster-plane objectives (bench.py `cluster_plane` section: a real
+    # 3-process cluster with one NetFault-delayed backup link —
+    # docs/OBSERVABILITY.md "cluster plane"). replication_lag_p99_ms is
+    # the broadcast→prepare_ok arrival tail over every remote ack;
+    # quorum_straggler_p99_ms the q-th-arrival→straggler overhang. The
+    # injected delay dominates both, so the >10% rule tracks the
+    # replication plane and its telemetry rather than host noise. Absent
+    # from pre-cluster-plane baselines: n/a, not failure; a crashed
+    # section records neither key → MISSING → fail-closed. The per-peer
+    # separation evidence (delayed vs healthy peer p99, straggler
+    # attribution) is recorded but NOT gated (the acceptance test
+    # asserts the separation; its ratio swings with scheduler jitter).
+    ("cluster_plane", "replication_lag_p99_ms", False),
+    ("cluster_plane", "quorum_straggler_p99_ms", False),
 )
 
 

@@ -179,6 +179,25 @@ def summarize_ops(path: str, limit: int = 16) -> str:
             bar = "#" * max(1 if ms > 0 else 0,
                             round(_BAR_WIDTH * ms / scale_ms))
             lines.append(f"  {comp[3:]:18s} {ms:9.3f} ms  {bar}")
+            if comp == "op.queue.quorum" and r.get("peer_ok_ms"):
+                # Cluster-plane sub-rows: per-peer prepare_ok arrivals
+                # (broadcast-relative) under the quorum wait they
+                # decompose — ✓q marks the ack that completed the
+                # quorum, +straggler the arrivals past it.
+                quorum_ms = r.get("quorum_ms")
+                quorum_peer = r.get("quorum_peer")
+                for peer in sorted(r["peer_ok_ms"], key=int):
+                    ok_ms = r["peer_ok_ms"][peer]
+                    pbar = "·" * max(1 if ok_ms > 0 else 0,
+                                     round(_BAR_WIDTH * ok_ms / scale_ms))
+                    tag = ""
+                    if quorum_peer is not None and int(peer) == quorum_peer:
+                        tag = "  ✓q"
+                    elif quorum_ms is not None and ok_ms > quorum_ms:
+                        tag = f"  +{ok_ms - quorum_ms:.3f} straggler"
+                    lines.append(
+                        f"    peer {peer} ok     {ok_ms:9.3f} ms  {pbar}{tag}"
+                    )
     lines.append(
         f"\ncomponent totals over all {len(recs)} records (critical-path"
         " ranking):"
