@@ -41,6 +41,9 @@ WINDOWS = 6  # timed dispatches
 
 LSM_ROWS = int(os.environ.get("BENCH_LSM_ROWS", 5_000_000))
 E2E_TRANSFERS = int(os.environ.get("BENCH_E2E_TRANSFERS", 40 * 8190))
+# compaction_under_load preload: 10x the e2e serving run, so the forced
+# storm has a real multi-level store to fold while commits keep landing.
+STORM_TRANSFERS = int(os.environ.get("BENCH_STORM_TRANSFERS", 10 * E2E_TRANSFERS))
 
 
 def _staged_fns(commit_ops, jnp, jax, n, n_accounts, zipf_cdf=None):
@@ -467,6 +470,101 @@ def bench_exact(mix: str):
     }
 
 
+def _bench_compaction_under_load():
+    """compaction_under_load: a forced all-level major compaction (storm)
+    racing a served open-loop transfer stream on one in-process state
+    machine (docs/COMMIT_PIPELINE.md "Streaming compaction").
+
+    Preload STORM_TRANSFERS (10x the e2e run) through the commit apply
+    path so every content tree holds a real multi-level store, measure a
+    steady serving window, then queue the storm and keep serving until it
+    drains — the storm folds through the same per-op beats the commits
+    pay for, paced by the adaptive quota. Records the storm's fold rate
+    (rows queued / wall time to drain, serving included), the serving
+    dip while it ran, and what ONE lazy full-table bloom pass costs (the
+    second pass the fused builder eliminates; recorded, not gated)."""
+    from tigerbeetle_tpu import types as _types
+    from tigerbeetle_tpu.constants import PRODUCTION
+    from tigerbeetle_tpu.lsm.store import Bloom
+    from tigerbeetle_tpu.models.state_machine import StateMachine
+
+    sm = StateMachine(PRODUCTION, backend="numpy")
+    n_acc = 256
+    acc = np.zeros(n_acc, dtype=_types.ACCOUNT_DTYPE)
+    acc["id_lo"] = np.arange(1, n_acc + 1, dtype=np.uint64)
+    acc["ledger"] = 1
+    acc["code"] = 1
+    sm.create_accounts(acc)
+    sm.compact_beat()
+
+    rng = np.random.default_rng(16)
+    next_id = 1
+
+    def serve(n_batches):
+        """Open-loop serving: full batches, one commit+beat per op (the
+        replica's serial commit path, minus the wire)."""
+        nonlocal next_id
+        t0 = time.perf_counter()
+        for _ in range(n_batches):
+            t = np.zeros(BATCH, dtype=_types.TRANSFER_DTYPE)
+            t["id_lo"] = np.arange(next_id, next_id + BATCH, dtype=np.uint64)
+            debit = rng.integers(1, n_acc + 1, BATCH, dtype=np.uint64)
+            t["debit_account_id_lo"] = debit
+            t["credit_account_id_lo"] = debit % np.uint64(n_acc) + np.uint64(1)
+            t["amount_lo"] = 1
+            t["ledger"] = 1
+            t["code"] = 1
+            sm.create_transfers(t)
+            sm.compact_beat()
+            next_id += BATCH
+        return n_batches * BATCH, time.perf_counter() - t0
+
+    serve(max(1, STORM_TRANSFERS // BATCH))  # preload at 10x e2e scale
+
+    # Steady serving window: normal beats only, no storm queued.
+    base_tx, base_s = 0, 0.0
+    while base_s < 0.8:
+        done, dt = serve(2)
+        base_tx += done
+        base_s += dt
+    base_rate = base_tx / base_s
+
+    rows_queued = sm.request_major_compaction()
+    t0 = time.perf_counter()
+    storm_tx = 0
+    while sm.compaction_storm_active():
+        done, _dt = serve(1)
+        storm_tx += done
+    storm_s = time.perf_counter() - t0
+    storm_rate = storm_tx / storm_s
+    dip = max(0.0, (base_rate - storm_rate) / base_rate * 100.0)
+
+    # One lazy streaming bloom pass over the largest storm output table:
+    # the exact work the fused builder folds into the merge output pass.
+    tree = sm.transfer_index
+    tables = [t for lvl in tree.levels for t in lvl if t.count]
+    bloom_ms = None
+    if tables:
+        table = max(tables, key=lambda t: t.count)
+        t0 = time.perf_counter()
+        b = Bloom(2 * table.count)
+        for f in tree._table_fences(table):
+            bk, _bv = tree._read_data_block(int(f["block"]), int(f["count"]))
+            b.add(bk["lo"], bk["hi"])
+        bloom_ms = round((time.perf_counter() - t0) * 1e3, 2)
+
+    return {
+        "preloaded_transfers": next_id - 1 - base_tx - storm_tx,
+        "rows_queued": rows_queued,
+        "major_compaction_rows_per_s": round(rows_queued / storm_s, 1),
+        "serving_tx_per_s_steady": round(base_rate, 1),
+        "serving_tx_per_s_storm": round(storm_rate, 1),
+        "e2e_dip_pct": round(dip, 1),
+        "storm_drain_s": round(storm_s, 2),
+        "bloom_build_ms_per_table": bloom_ms,
+    }
+
+
 def bench_config5_lsm():
     """Config 5: LSM ingest + forced major compaction (host tier over a
     file-backed grid) + the device streaming-merge kernel in isolation."""
@@ -668,6 +766,10 @@ def bench_config5_lsm():
     assert mk.tobytes() == sk.tobytes() and mv.tobytes() == sv.tobytes()
     out["kway_merge_rows_per_s"] = round(runs * per / max(t_merge, 1e-9), 1)
     out["kway_vs_radix_speedup"] = round(t_sort / max(t_merge, 1e-9), 2)
+
+    # Streaming compaction under load (ISSUE 16): the storm racing live
+    # commits on an in-process state machine; both headline keys gated.
+    out["compaction_under_load"] = _bench_compaction_under_load()
     return out
 
 

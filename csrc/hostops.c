@@ -181,12 +181,27 @@ void hostops_bloom_add(
     uint64_t *words, uint64_t bit_mask, int64_t n,
     const uint64_t *lo, const uint64_t *hi
 ) {
-    for (int64_t i = 0; i < n; i++) {
-        uint64_t h1, h2;
-        bloom_hash2(lo[i], hi[i], &h1, &h2);
-        uint64_t b1 = h1 & bit_mask, b2 = h2 & bit_mask;
-        words[b1 >> 6] |= 1ull << (b1 & 63);
-        words[b2 >> 6] |= 1ull << (b2 & 63);
+    /* Two-phase per block: the hash phase streams the keys and
+     * prefetches the (randomly addressed) filter words the set phase
+     * will touch — on filters past L2 size the word fetch is the whole
+     * cost, and the prefetch pipeline hides most of it. */
+    uint64_t b1s[256], b2s[256];
+    int64_t i = 0;
+    while (i < n) {
+        int64_t c = n - i < 256 ? n - i : 256;
+        for (int64_t t = 0; t < c; t++) {
+            uint64_t h1, h2;
+            bloom_hash2(lo[i + t], hi[i + t], &h1, &h2);
+            b1s[t] = h1 & bit_mask;
+            b2s[t] = h2 & bit_mask;
+            __builtin_prefetch(&words[b1s[t] >> 6], 1);
+            __builtin_prefetch(&words[b2s[t] >> 6], 1);
+        }
+        for (int64_t t = 0; t < c; t++) {
+            words[b1s[t] >> 6] |= 1ull << (b1s[t] & 63);
+            words[b2s[t] >> 6] |= 1ull << (b2s[t] & 63);
+        }
+        i += c;
     }
 }
 
@@ -310,56 +325,140 @@ int hostops_sort_kv(
  * best other head is block-copied — pre-sorted and dup-heavy inputs then
  * cost ~memcpy instead of a per-row heap. runs_keys rows are KEY_DTYPE
  * (hi u64 first, lo u64 second). */
-int hostops_merge_kv(
+/* Selection runs over a binary min-heap of run heads keyed (lo, run) —
+ * lexicographic, so ties surface the EARLIEST run, preserving the
+ * stability contract above. The runner-up (the gallop bound) is the
+ * smaller of the root's two children: in a binary min-heap the
+ * second-smallest element is always a child of the root. At k = 64 this
+ * replaces two O(k) head scans per gallop segment with O(log k)
+ * sift-downs — the wide single-pass fold's selection cost.
+ *
+ * The _bloom variant fuses Bloom-filter population into the output
+ * copy: seg_ends[nseg] are cumulative output-row boundaries (the
+ * compaction writer's table spans, emitted by the caller in the same
+ * pass that sizes the merge — table-boundary splits no longer need a
+ * re-scan), seg_words[s] points at table s's filter words (NULL skips
+ * that span, e.g. the trailing partial table that stays lazily built),
+ * seg_masks[s] is its bit mask. Bits are set from the just-copied
+ * output rows while they are still cache-hot, so the separate
+ * per-table streaming bloom pass disappears. */
+typedef struct { uint64_t lo; int64_t run; } merge_head;
+
+static inline int head_lt(merge_head a, merge_head b) {
+    return a.lo < b.lo || (a.lo == b.lo && a.run < b.run);
+}
+
+int hostops_merge_kv_bloom(
     int64_t k, const uint64_t **runs_keys, const uint32_t **runs_vals,
-    const int64_t *ns, uint64_t *keys_out, uint32_t *vals_out
+    const int64_t *ns, uint64_t *keys_out, uint32_t *vals_out,
+    int64_t nseg, const int64_t *seg_ends,
+    uint64_t *const *seg_words, const uint64_t *seg_masks
 ) {
     if (k <= 0) return 0;
-    int64_t idx[64];
     if (k > 64) return -1;
-    for (int64_t r = 0; r < k; r++) idx[r] = 0;
+    int64_t idx[64];
+    merge_head heap[64];
+    int64_t hn = 0;
+    for (int64_t r = 0; r < k; r++) {
+        idx[r] = 0;
+        if (ns[r] <= 0) continue;
+        merge_head h = { runs_keys[r][1], r };
+        int64_t i = hn++;
+        while (i > 0) { /* sift up */
+            int64_t p = (i - 1) >> 1;
+            if (!head_lt(h, heap[p])) break;
+            heap[i] = heap[p];
+            i = p;
+        }
+        heap[i] = h;
+    }
     int64_t out = 0;
-    for (;;) {
-        /* Earliest run with the minimal head lo. */
-        int64_t r = -1;
-        uint64_t m = 0;
-        for (int64_t i = 0; i < k; i++) {
-            if (idx[i] >= ns[i]) continue;
-            uint64_t lo = runs_keys[i][2 * idx[i] + 1];
-            if (r < 0 || lo < m) { r = i; m = lo; }
-        }
-        if (r < 0) break;
-        /* Best head among the OTHER live runs (earliest on ties). */
-        int64_t r2 = -1;
-        uint64_t m2 = 0;
-        for (int64_t i = 0; i < k; i++) {
-            if (i == r || idx[i] >= ns[i]) continue;
-            uint64_t lo = runs_keys[i][2 * idx[i] + 1];
-            if (r2 < 0 || lo < m2) { r2 = i; m2 = lo; }
-        }
+    while (hn > 0) {
+        int64_t r = heap[0].run;
         int64_t j = idx[r];
         int64_t end = ns[r];
-        if (r2 >= 0) {
+        if (hn == 1) {
+            j = end; /* last live run: drain it */
+        } else {
+            merge_head m2 = heap[1];
+            if (hn > 2 && head_lt(heap[2], m2)) m2 = heap[2];
             /* Take r's prefix while its key precedes every other head:
              * strictly smaller lo, or a tie with a LATER run (stability:
              * the earlier run's equal keys all come first). */
-            if (r < r2) {
-                while (j < end && runs_keys[r][2 * j + 1] <= m2) j++;
+            if (r < m2.run) {
+                while (j < end && runs_keys[r][2 * j + 1] <= m2.lo) j++;
             } else {
-                while (j < end && runs_keys[r][2 * j + 1] < m2) j++;
+                while (j < end && runs_keys[r][2 * j + 1] < m2.lo) j++;
             }
-        } else {
-            j = end; /* last live run: drain it */
         }
         int64_t cnt = j - idx[r];
         memcpy(keys_out + 2 * out, runs_keys[r] + 2 * idx[r],
                (size_t)cnt * 16);
         memcpy(vals_out + out, runs_vals[r] + idx[r],
                (size_t)cnt * sizeof(uint32_t));
-        out += cnt;
         idx[r] = j;
+        out += cnt;
+        if (j >= end) {
+            heap[0] = heap[--hn];
+        } else {
+            heap[0].lo = runs_keys[r][2 * j + 1];
+            heap[0].run = r;
+        }
+        merge_head h = heap[0];
+        int64_t i = 0;
+        for (;;) { /* sift down */
+            int64_t c = 2 * i + 1;
+            if (c >= hn) break;
+            if (c + 1 < hn && head_lt(heap[c + 1], heap[c])) c++;
+            if (!head_lt(heap[c], h)) break;
+            heap[i] = heap[c];
+            i = c;
+        }
+        heap[i] = h;
+    }
+    /* Segmented Bloom pass over the finished output, still inside this
+     * call while the chunk is cache-hot. Kept OUT of the heap loop: the
+     * filter words are a large random-access array, and interleaving
+     * their cache misses with the selection loop stalled it; here the
+     * hash phase streams sequentially and prefetches each word a block
+     * ahead of the set phase. Bits are identical to the inline form. */
+    for (int64_t s = 0, p = 0; s < nseg && p < out; s++) {
+        int64_t lim = seg_ends[s] < out ? seg_ends[s] : out;
+        uint64_t *words = seg_words[s];
+        if (words && lim > p) {
+            uint64_t bm = seg_masks[s];
+            uint64_t b1s[256], b2s[256];
+            int64_t i = p;
+            while (i < lim) {
+                int64_t n = lim - i < 256 ? lim - i : 256;
+                for (int64_t t = 0; t < n; t++) {
+                    uint64_t h1, h2;
+                    /* keys_out rows: hi first, lo second */
+                    bloom_hash2(keys_out[2 * (i + t) + 1],
+                                keys_out[2 * (i + t)], &h1, &h2);
+                    b1s[t] = h1 & bm;
+                    b2s[t] = h2 & bm;
+                    __builtin_prefetch(&words[b1s[t] >> 6], 1);
+                    __builtin_prefetch(&words[b2s[t] >> 6], 1);
+                }
+                for (int64_t t = 0; t < n; t++) {
+                    words[b1s[t] >> 6] |= 1ull << (b1s[t] & 63);
+                    words[b2s[t] >> 6] |= 1ull << (b2s[t] & 63);
+                }
+                i += n;
+            }
+        }
+        if (lim > p) p = lim;
     }
     return 0;
+}
+
+int hostops_merge_kv(
+    int64_t k, const uint64_t **runs_keys, const uint32_t **runs_vals,
+    const int64_t *ns, uint64_t *keys_out, uint32_t *vals_out
+) {
+    return hostops_merge_kv_bloom(k, runs_keys, runs_vals, ns,
+                                  keys_out, vals_out, 0, 0, 0, 0);
 }
 
 /* ------------------------------------------------- fast-path staging */

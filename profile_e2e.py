@@ -388,6 +388,35 @@ def main(backend="numpy", batches=40, overlap=True, store_async=True,
             record[stage] = round(ms / batches, 3)
             print(f"  {stage:14s} {ms / batches:9.2f} {p50:9.1f} {p99:9.1f}")
 
+    # Streaming-compaction decomposition (docs/COMMIT_PIPELINE.md
+    # "Streaming compaction"): the merge/bloom/build sub-spans NEST
+    # inside the beat row (sm.beat → compact_step), and compact.device
+    # (the split-phase fold's dispatch→materialize latency) OVERLAPS the
+    # host-side build between its two halves — so this is its own table,
+    # never added to the disjoint stage attribution above. compact.beat
+    # repeats the beat row as the table's enclosing total; forward is
+    # the fault-retry fast-forward replay (zero in a healthy run).
+    compact_rows = {
+        "compact.beat": ("sm.beat",),
+        "compact.forward": ("lsm.compact.forward",),
+        "compact.merge": ("lsm.compact.merge",),
+        "compact.bloom": ("lsm.compact.bloom",),
+        "compact.build": ("lsm.compact.build",),
+        "compact.device": ("device.step.compact_fold",),
+    }
+    if any(span_ms(keys) for keys in compact_rows.values()
+           if keys != ("sm.beat",)):
+        print("\nstreaming compaction (nested inside the beat row; device "
+              "half overlaps host build):")
+        print(f"  {'span':16s} {'ms/batch':>9s} {'p50_us':>9s} {'p99_us':>9s}")
+        for stage, keys in compact_rows.items():
+            ms = span_ms(keys)
+            if not ms:
+                continue
+            p50, p99 = span_pcts(keys)
+            record[stage] = round(ms / batches, 3)
+            print(f"  {stage:16s} {ms / batches:9.2f} {p50:9.1f} {p99:9.1f}")
+
     # Native bus codec sub-spans (docs/NATIVE_DATAPATH.md): scan+decode
     # nest inside the parse row, encode inside the reply row — their own
     # table, never added to the disjoint stage attribution above. This

@@ -1,0 +1,38 @@
+"""Fixture: the streaming-compaction device fold's compile gate.
+Findings asserted EXACTLY by tests/test_jaxlint.py — edit in lockstep.
+
+compact_fold_kernel is a registered jit entry (tidy/manifest.JIT_ENTRIES):
+feeding it runtime-shaped stacks is a retrace per chunk size, which on a
+storm's chunk stream means a fresh XLA compile mid-merge. The sanctioned
+shape gate is _stack_pow2 (JAXLINT_PAD_HELPERS): pow-2 run count and
+bucket, so the kernel compiles once per (k, b) bucket pair and
+steady-state beats stay at zero new compiles.
+"""
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def compact_fold_kernel(keys_stack, pays_stack):
+    return keys_stack, pays_stack
+
+
+def _stack_pow2(parts_k, parts_v):
+    k_pad = 1 << max(0, (len(parts_k) - 1).bit_length())
+    b = 1 << max(8, (max(len(p) for p in parts_k) - 1).bit_length())
+    ks = np.zeros((k_pad, b, 3), dtype=np.uint32)
+    ps = np.zeros((k_pad, b, 3), dtype=np.uint32)
+    return ks, ps
+
+
+def fold_ungated(parts_k, parts_v):
+    # retrace-shape fires HERE: a chunk-sized stack reaches the entry.
+    ks = np.zeros((len(parts_k), len(parts_k[0]), 3), dtype=np.uint32)
+    ps = np.asarray(parts_v)
+    return compact_fold_kernel(ks, ps)
+
+
+def fold_gated(parts_k, parts_v):
+    ks, ps = _stack_pow2(parts_k, parts_v)  # pad helper: compile-gated
+    return compact_fold_kernel(ks, ps)

@@ -123,6 +123,33 @@ def test_retrace_fixture_exact_findings():
     assert "np.zeros" in src[named.line - 1]
 
 
+def test_compact_fold_entry_is_compile_gated():
+    """The streaming-compaction device fold is a registered jit entry:
+    runtime-shaped chunk stacks reaching it are flagged (a retrace per
+    chunk size, i.e. a fresh XLA compile mid-storm), while the sanctioned
+    _stack_pow2 pad helper's pow-2 buckets pass clean — the shape gate
+    that keeps config5's steady_compiles exact."""
+    from tigerbeetle_tpu.tidy import jaxlint, manifest
+
+    # The real kernel + its gate are registered, not just the fixture's.
+    assert "compact_fold_kernel" in manifest.JIT_ENTRIES
+    assert "_stack_pow2" in manifest.JAXLINT_PAD_HELPERS
+    assert (
+        "tigerbeetle_tpu/ops/merge.py", "compact_fold_materialize"
+    ) in manifest.JAXLINT_SYNC_SEAM
+
+    findings = jaxlint.analyze_file(
+        FIXTURES / "retrace_compact.py", REPO, passes=("retrace",)
+    )
+    got = [(f.code, f.scope, f.subject) for f in findings]
+    assert got == [
+        ("retrace-shape", "fold_ungated", "compact_fold_kernel"),
+        ("retrace-shape", "fold_ungated", "compact_fold_kernel"),
+    ], findings
+    # No finding in fold_gated: _stack_pow2's result is shape-stabilized.
+    assert all(f.scope != "fold_gated" for f in findings)
+
+
 # --- reduction pass ------------------------------------------------------
 
 

@@ -171,6 +171,72 @@ class TestDurableIndex:
 
         assert dump(idx_h) == dump(idx_d)
 
+    def test_storm_device_and_host_identical(self, monkeypatch):
+        """Determinism guard for the streaming storm engine: a forced
+        all-level major compaction through the device fold kernel
+        (split-phase, double-buffered) leaves byte-identical state —
+        manifest, fences, and raw grid bytes — to the host tier."""
+        monkeypatch.setenv("TIGERBEETLE_TPU_DEVICE_MERGE", "1")
+
+        def run(backend):
+            grid, idx, lo, hi, vals = self._rand_index(backend=backend)
+            assert idx.request_major() > 0
+            beats = 0
+            while idx.storm_active():
+                idx.compact_step(2048)  # paced: the job spans many beats
+                beats += 1
+                assert beats < 10_000
+            assert beats > 1  # actually incremental, not one mega-step
+            return grid, idx, lo, hi, vals
+
+        grid_h, idx_h, lo, hi, vals = run("numpy")
+        grid_d, idx_d, _, _, _ = run("jax")
+        assert idx_h.checkpoint().tobytes() == idx_d.checkpoint().tobytes()
+        fh, ch = idx_h.checkpoint_fences()
+        fd, cd = idx_d.checkpoint_fences()
+        assert fh.tobytes() == fd.tobytes() and ch.tobytes() == cd.tobytes()
+        span = grid_h.block_count * grid_h.block_size
+        assert grid_h.storage.read(0, span) == grid_d.storage.read(0, span)
+        # Content survived, one bottom run.
+        q = pack_keys(lo[::13], hi[::13])
+        assert (idx_h.lookup_batch(q) == vals[::13]).all()
+        assert (idx_d.lookup_batch(q) == vals[::13]).all()
+
+    def test_fused_blooms_bit_identical_and_fp_pinned(self):
+        """Compaction outputs carry Blooms built INSIDE the merge's
+        output pass (csrc/hostops.c fused path). The filter must be
+        bit-identical to the lazy two-pass build — same sizing, same
+        words, same count — and its false-positive rate stays at the
+        documented ~16 bits/key operating point."""
+        from tigerbeetle_tpu.lsm.store import Bloom
+
+        grid, idx, lo, hi, vals = self._rand_index()
+        idx.drain_compaction()
+        fused = 0
+        for level in idx.levels:
+            for t in level:
+                if t.bloom is None:
+                    continue
+                fused += 1
+                parts = [
+                    idx._read_data_block(int(f["block"]), int(f["count"]))[0]
+                    for f in idx._table_fences(t)
+                ]
+                keys = np.concatenate(parts)
+                ref = Bloom(2 * len(keys))  # _key_bloom's exact sizing
+                ref.add(keys["lo"], keys["hi"])
+                assert len(ref.words) == len(t.bloom.words)
+                assert (ref.words == t.bloom.words).all()
+                assert ref.count == t.bloom.count
+                # FP rate at the 16-bits/key design point: probe keys
+                # guaranteed absent (lo beyond every inserted key).
+                rng = np.random.default_rng(7)
+                miss_lo = rng.integers(1 << 40, 1 << 50, 4096).astype(np.uint64)
+                miss_hi = rng.integers(0, 1 << 32, 4096).astype(np.uint64)
+                fp = float(np.mean(t.bloom.maybe(miss_lo, miss_hi)))
+                assert fp < 0.05, fp
+        assert fused > 0  # compaction ran and attached filters
+
     def test_duplicate_key_range(self):
         grid = MemGrid(block_count=4096, block_size=4096)
         nu = DurableIndex(grid, unique=False, memtable_max=128, growth=3)
@@ -429,6 +495,82 @@ class TestCrossCheckpointCompaction:
         assert fa.tobytes() == fb.tobytes()
         assert ca.tobytes() == cb.tobytes()
 
+    def test_mid_storm_checkpoint_restart(self):
+        """Crash-restart in the MIDDLE of a compaction storm: the job
+        descriptor persists with the storm sentinel level (its inputs
+        span every level, oldest-first), and a replica restarted from the
+        checkpoint finishes the storm with byte-identical manifests and
+        block indices to one that never restarted."""
+        from tigerbeetle_tpu.lsm.tree import _STORM_LEVEL
+
+        def build(grid):
+            tree = DurableIndex(grid, unique=True, memtable_max=64, growth=8)
+            self._fill(tree, n_batches=12)
+            assert tree.request_major() > 0
+            assert tree.compact_step(quota_entries=96)  # storm mid-flight
+            assert tree._job is not None and tree._job.is_storm
+            return tree
+
+        grid_a = MemGrid(1 << 11, 1 << 12)
+        tree_a = build(grid_a)
+        manifest = tree_a.checkpoint()
+        fences, counts = tree_a.checkpoint_fences()
+        level, n_inputs, progress, resv = tree_a.job_state()
+        assert level == _STORM_LEVEL
+        storm_flag = tree_a.storm_state()
+
+        grid_b = MemGrid(1 << 11, 1 << 12)
+        tree_b = build(grid_b)
+        tree_b.checkpoint()
+        tree_b2 = DurableIndex(grid_b, unique=True, memtable_max=64, growth=8)
+        tree_b2.restore(manifest)
+        tree_b2.attach_fences(fences, counts)
+        tree_b2.restore_storm(storm_flag)
+        tree_b2.restore_job(level, n_inputs, progress, resv)
+        assert tree_b2.storm_active()
+
+        # Inserts keep landing mid-storm on BOTH sides (level-0 appends
+        # stay outside the captured oldest-first prefix).
+        for tree in (tree_a, tree_b2):
+            extra = pack_keys(
+                np.arange(10_001, 10_065, dtype=np.uint64),
+                np.zeros(64, dtype=np.uint64),
+            )
+            tree.insert_batch(extra, np.arange(64, dtype=np.uint32))
+            while tree.compact_step(96):
+                pass
+        ma, mb = tree_a.checkpoint(), tree_b2.checkpoint()
+        assert ma.tobytes() == mb.tobytes()
+        fa, ca = tree_a.checkpoint_fences()
+        fb, cb = tree_b2.checkpoint_fences()
+        assert fa.tobytes() == fb.tobytes()
+        assert ca.tobytes() == cb.tobytes()
+        # Post-storm shape: everything merged to a single bottom run
+        # (later inserts may sit above it), with fused Blooms attached.
+        assert all(t.bloom is not None for t in tree_a.levels[-1])
+
+    def test_storm_request_flag_roundtrip(self):
+        """A storm queued but not yet planned (request_major before the
+        first free beat) survives checkpoint/restore via storm_state —
+        else a restarted replica silently drops the forced major."""
+        grid = MemGrid(1 << 11, 1 << 12)
+        tree = DurableIndex(grid, unique=True, memtable_max=64)
+        self._fill(tree)
+        tree.drain_compaction()
+        self._fill(tree, n_batches=2, seed=10)  # ≥2 tables post-drain
+        assert tree.request_major() > 0
+        assert tree.storm_state() == 1 and tree.job_state() is None
+        manifest = tree.checkpoint()
+        fences, counts = tree.checkpoint_fences()
+        tree2 = DurableIndex(grid, unique=True, memtable_max=64)
+        tree2.restore(manifest)
+        tree2.attach_fences(fences, counts)
+        tree2.restore_storm(tree.storm_state())
+        assert tree2.storm_active()
+        while tree2.compact_step(1 << 62):
+            pass
+        assert not tree2.storm_active()
+
 
 class TestSortKv:
     """The fused C sort+gather (hostops_sort_kv) must match the two-step
@@ -451,3 +593,78 @@ class TestSortKv:
             k2, v2 = sort_kv(keys, vals)
             assert k2.tobytes() == keys[order].tobytes(), n
             assert v2.tobytes() == vals[order].tobytes(), n
+
+
+class TestWideKwayMerge:
+    """The heap-based C merge core (round 16: O(log k) winner selection,
+    ≤64-way groups) must keep the galloping path's contract: byte-stable
+    against a concatenate+stable-sort oracle at every width, including
+    dup-heavy ties where stability = age precedence = correctness."""
+
+    @staticmethod
+    def _parts(rng, k, dup_heavy):
+        parts_k, parts_v = [], []
+        base = 0
+        for _ in range(k):
+            n = int(rng.integers(100, 2000))
+            span = 8 if dup_heavy else 1 << 60
+            lo = np.sort(rng.integers(0, span, n).astype(np.uint64))
+            hi = rng.integers(0, 1 << 32, n).astype(np.uint64)
+            parts_k.append(pack_keys(lo, hi))
+            parts_v.append(
+                (base + np.arange(n)).astype(np.uint32)
+            )
+            base += n
+        return parts_k, parts_v
+
+    @pytest.mark.parametrize("k", [2, 3, 7, 33, 64, 80])
+    @pytest.mark.parametrize("dup_heavy", [False, True])
+    def test_matches_stable_sort_oracle(self, k, dup_heavy):
+        from tigerbeetle_tpu.lsm.store import merge_host_kway
+
+        rng = np.random.default_rng(k * 2 + int(dup_heavy))
+        parts_k, parts_v = self._parts(rng, k, dup_heavy)
+        mk, mv = merge_host_kway(parts_k, parts_v)
+        ck = np.concatenate(parts_k)
+        cv = np.concatenate(parts_v)
+        order = np.argsort(ck["lo"], kind="stable")
+        assert mk.tobytes() == ck[order].tobytes()
+        assert mv.tobytes() == cv[order].tobytes()
+
+    def test_fused_bloom_variant_same_bytes_and_bits(self):
+        """merge_host_kway_bloom: output bytes identical to the plain
+        merge; segment Blooms bit-identical to a post-hoc add over the
+        finished slices (None segments skipped)."""
+        from tigerbeetle_tpu.lsm.store import (
+            Bloom, merge_host_kway, merge_host_kway_bloom,
+        )
+
+        rng = np.random.default_rng(42)
+        for k in (2, 9, 64):
+            parts_k, parts_v = self._parts(rng, k, dup_heavy=False)
+            total = sum(len(p) for p in parts_k)
+            span = 1536
+            ends, blooms, pos = [], [], 0
+            while pos < total:
+                end = min(pos + span, total)
+                ends.append(end)
+                blooms.append(None if len(ends) % 3 == 0 else Bloom(
+                    2 * (end - pos)
+                ))
+                pos = end
+            mk, mv = merge_host_kway_bloom(
+                [p.copy() for p in parts_k], [p.copy() for p in parts_v],
+                ends, blooms,
+            )
+            rk, rv = merge_host_kway(parts_k, parts_v)
+            assert mk.tobytes() == rk.tobytes()
+            assert mv.tobytes() == rv.tobytes()
+            start = 0
+            for end, b in zip(ends, blooms):
+                if b is not None:
+                    ref = Bloom(2 * (end - start))
+                    seg = rk[start:end]
+                    ref.add(seg["lo"], seg["hi"])
+                    assert (ref.words == b.words).all(), (k, start, end)
+                    assert ref.count == b.count
+                start = end

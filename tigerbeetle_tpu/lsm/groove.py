@@ -106,6 +106,18 @@ class PostedGroove:
     def compact_step(self, quota_entries: int = DEFAULT_COMPACT_QUOTA) -> None:
         self.index.compact_step(quota_entries)
 
+    def compact_backlog(self) -> int:
+        return self.index.compact_backlog()
+
+    def request_major(self) -> int:
+        return self.index.request_major()
+
+    def storm_active(self) -> bool:
+        return self.index.storm_active()
+
+    def compact_prefetch_one(self) -> bool:
+        return self.index.compact_prefetch_one()
+
 
 class _PostedView:
     """Per-batch dict-facade over a PostedGroove for the serial oracle:
@@ -190,6 +202,18 @@ class HistoryGroove:
 
     def compact_step(self, quota_entries: int = DEFAULT_COMPACT_QUOTA) -> None:
         self.rows.compact_step(quota_entries)
+
+    def compact_backlog(self) -> int:
+        return self.rows.compact_backlog()
+
+    def request_major(self) -> int:
+        return self.rows.request_major()
+
+    def storm_active(self) -> bool:
+        return self.rows.storm_active()
+
+    def compact_prefetch_one(self) -> bool:
+        return self.rows.compact_prefetch_one()
 
     def flush_pending(self, max_blocks: int) -> None:
         self.log.flush_pending(max_blocks)

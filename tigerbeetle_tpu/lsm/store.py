@@ -77,6 +77,73 @@ def sort_kv(keys: np.ndarray, vals: np.ndarray):
     return keys[order], np.asarray(vals, dtype=np.uint32)[order]
 
 
+def _bloom_fill(keys, seg_ends, seg_blooms) -> None:
+    """Two-pass fallback for merge_host_kway_bloom: populate per-segment
+    filters from the finished output slices — same bits as the fused C
+    path (identical hash, identical rows), just set after the copy."""
+    start = 0
+    for end, bloom in zip(seg_ends, seg_blooms):
+        end = min(int(end), len(keys))
+        if bloom is not None and end > start:
+            seg = keys[start:end]
+            bloom.add(seg["lo"], seg["hi"])
+        start = max(start, end)
+
+
+def _merge_c(lib, group, seg_ends=None, seg_blooms=None):
+    """One C merge call over ≤64 runs. With a segment plan, Bloom bits
+    are set inside the merge's output pass (hostops_merge_kv_bloom);
+    stale shims and C failures degrade to merge-then-fill."""
+    import ctypes
+
+    k = len(group)
+    total = sum(len(pk) for pk, _ in group)
+    keys_c = [np.ascontiguousarray(pk) for pk, _ in group]
+    vals_c = [np.ascontiguousarray(pv, dtype=np.uint32) for _, pv in group]
+    kp = (ctypes.c_void_p * k)(*[a.ctypes.data for a in keys_c])
+    vp = (ctypes.c_void_p * k)(*[a.ctypes.data for a in vals_c])
+    ns = (ctypes.c_int64 * k)(*[len(a) for a in keys_c])
+    out_k = np.empty(total, dtype=keys_c[0].dtype)
+    out_v = np.empty(total, dtype=np.uint32)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    if seg_ends is not None and hasattr(lib, "hostops_merge_kv_bloom"):
+        nseg = len(seg_ends)
+        ends = (ctypes.c_int64 * nseg)(*[int(e) for e in seg_ends])
+        words = (ctypes.c_void_p * nseg)(
+            *[None if b is None else b.words.ctypes.data for b in seg_blooms]
+        )
+        masks = np.ascontiguousarray(
+            [0 if b is None else int(b._mask) for b in seg_blooms],
+            dtype=np.uint64,
+        )
+        rc = lib.hostops_merge_kv_bloom(
+            k, kp, vp, ns,
+            out_k.ctypes.data_as(u64p), out_v.ctypes.data_as(u32p),
+            nseg, ends, words, masks.ctypes.data_as(u64p),
+        )
+        if rc == 0:
+            start = 0
+            for end, bloom in zip(seg_ends, seg_blooms):
+                end = min(int(end), total)
+                if bloom is not None:
+                    bloom.count += max(0, end - start)
+                start = max(start, end)
+            return out_k, out_v
+    rc = lib.hostops_merge_kv(
+        k, kp, vp, ns,
+        out_k.ctypes.data_as(u64p), out_v.ctypes.data_as(u32p),
+    )
+    if rc != 0:
+        out_k, out_v = sort_kv(
+            np.concatenate([pk for pk, _ in group]),
+            np.concatenate([pv for _, pv in group]),
+        )
+    if seg_ends is not None:
+        _bloom_fill(out_k, seg_ends, seg_blooms)
+    return out_k, out_v
+
+
 def merge_host_kway(parts_k, parts_v):
     """Stable k-way merge of lo-major SORTED KEY_DTYPE runs on the host:
     equal-lo keys drain earlier runs first (callers pass oldest-first),
@@ -99,43 +166,46 @@ def merge_host_kway(parts_k, parts_v):
             np.concatenate([k for k, _ in parts]),
             np.concatenate([v for _, v in parts]),
         )
-    import ctypes
-
-    def merge_c(group):
-        k = len(group)
-        total = sum(len(pk) for pk, _ in group)
-        keys_c = [np.ascontiguousarray(pk) for pk, _ in group]
-        vals_c = [
-            np.ascontiguousarray(pv, dtype=np.uint32) for _, pv in group
-        ]
-        kp = (ctypes.c_void_p * k)(*[a.ctypes.data for a in keys_c])
-        vp = (ctypes.c_void_p * k)(*[a.ctypes.data for a in vals_c])
-        ns = (ctypes.c_int64 * k)(*[len(a) for a in keys_c])
-        out_k = np.empty(total, dtype=keys_c[0].dtype)
-        out_v = np.empty(total, dtype=np.uint32)
-        rc = lib.hostops_merge_kv(
-            k, kp, vp, ns,
-            out_k.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
-            out_v.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
-        )
-        if rc != 0:
-            return sort_kv(
-                np.concatenate([pk for pk, _ in group]),
-                np.concatenate([pv for _, pv in group]),
-            )
-        return out_k, out_v
-
-    # Fold in groups of ≤8: head selection scans the live heads linearly,
-    # so wide merges pay k compares per row — two narrow passes beat one
-    # wide one well before the shim's 64-run bound. Grouping consecutive
-    # runs preserves the oldest-first stability order.
-    while len(parts) > 8:
+    # Single pass up to the shim's 64-run bound: selection runs over a
+    # (lo, run) min-heap in C, so a wide merge pays O(log k) per gallop
+    # segment — one 64-way pass moves every row ONCE where the pre-r16
+    # linear-selection core had to fold in groups of 8 and move rows
+    # twice. Grouping consecutive runs preserves oldest-first stability.
+    while len(parts) > 64:
         parts = [
-            merge_c(parts[g : g + 8]) if len(parts[g : g + 8]) > 1
+            _merge_c(lib, parts[g : g + 64]) if len(parts[g : g + 64]) > 1
             else parts[g]
-            for g in range(0, len(parts), 8)
+            for g in range(0, len(parts), 64)
         ]
-    return merge_c(parts)
+    return _merge_c(lib, parts)
+
+
+def merge_host_kway_bloom(parts_k, parts_v, seg_ends, seg_blooms):
+    """merge_host_kway with Bloom population fused into the output copy.
+
+    `seg_ends` are cumulative OUTPUT-row boundaries (the compaction
+    writer's table spans over this merge's output); `seg_blooms[i]`
+    covers rows [seg_ends[i-1], seg_ends[i]), or None to leave that span
+    unfiltered (e.g. a trailing partial table that stays lazily built).
+    Bits are identical to Bloom.add over the finished output slices —
+    fusion only moves WHEN they are set (inside the C merge's output
+    pass, rows still cache-hot), never WHICH. Without the shim the
+    filters are filled in a second pass over the merged output."""
+    parts = [(k, v) for k, v in zip(parts_k, parts_v) if len(k)]
+    lib = _hostops()
+    if len(parts) <= 1 or lib is None or not hasattr(lib, "hostops_merge_kv"):
+        out_k, out_v = merge_host_kway(parts_k, parts_v)
+        _bloom_fill(out_k, seg_ends, seg_blooms)
+        return out_k, out_v
+    # Oversize inputs pre-fold without filters; only the last pass sees
+    # final output offsets, so only it can place segmented Bloom bits.
+    while len(parts) > 64:
+        parts = [
+            _merge_c(lib, parts[g : g + 64]) if len(parts[g : g + 64]) > 1
+            else parts[g]
+            for g in range(0, len(parts), 64)
+        ]
+    return _merge_c(lib, parts, seg_ends, seg_blooms)
 
 
 def sort_lo_major(keys: np.ndarray) -> np.ndarray:

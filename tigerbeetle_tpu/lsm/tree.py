@@ -37,7 +37,9 @@ from tigerbeetle_tpu.lsm.store import (
     KEY_DTYPE,
     NOT_FOUND,
     Bloom,
+    _bloom_fill,
     merge_host_kway,
+    merge_host_kway_bloom,
     search_run,
     sort_kv,
     sort_lo_major,
@@ -75,6 +77,10 @@ BLOCK_TYPE_INDEX = 2
 # constants.py cannot import this module (cycle via io.grid), so its
 # default duplicates the literal — asserted equal here.
 DEFAULT_COMPACT_QUOTA = 1 << 15
+
+# job_state() level sentinel for a storm job, whose inputs span EVERY
+# level (oldest-first) instead of prefixing one.
+_STORM_LEVEL = 0xFFFFFFFF
 
 from tigerbeetle_tpu.constants import Config as _Config  # noqa: E402
 
@@ -133,6 +139,7 @@ class _TableReader:
         self.tree = tree
         self.fences = tree._table_fences(table)
         self.pos = 0
+        self.prefetch_pos = 0
 
     def exhausted(self) -> bool:
         return self.pos >= len(self.fences)
@@ -142,21 +149,55 @@ class _TableReader:
         self.pos += 1
         return self.tree._read_data_block(int(f["block"]), int(f["count"]))
 
+    def prefetch_block(self) -> bool:
+        """Warm the next unread block into the grid cache (bounded two
+        blocks ahead of the merge cursor). Cache-temperature only."""
+        p = max(self.prefetch_pos, self.pos)
+        if p >= len(self.fences) or p - self.pos >= 2:
+            return False
+        self.tree.grid.read_block(int(self.fences[p]["block"]))
+        self.prefetch_pos = p + 1
+        return True
+
 
 class _MergeStream:
-    """Buffered stream over a sequence of tables (oldest-precedence side)."""
+    """Buffered stream over a sequence of tables (oldest-precedence side).
 
-    def __init__(self, tree: "DurableIndex", tables: List[TableInfo]) -> None:
+    `depth` is the refill read-ahead in blocks: a k-way merge's chunk size
+    is governed by the SMALLEST buffered tail across streams, so buffering
+    one block caps every chunk near one block's rows no matter how many
+    streams feed it — per-chunk costs (bound searchsorted × k, the C call,
+    the writer append) then dominate a wide merge. Deeper buffers trade
+    bounded memory (k × depth × epb rows, budgeted by the job) for chunks
+    that amortize those costs; the merge output is identical either way."""
+
+    def __init__(
+        self, tree: "DurableIndex", tables: List[TableInfo], depth: int = 1
+    ) -> None:
         self.readers = [_TableReader(tree, t) for t in tables]
+        self.depth = depth
         self.keys = np.zeros(0, dtype=KEY_DTYPE)
         self.vals = np.zeros(0, dtype=np.uint32)
 
     def refill(self) -> None:
-        while len(self.keys) == 0 and self.readers:
+        if len(self.keys) or not self.readers:
+            return
+        parts_k, parts_v = [], []
+        blocks = 0
+        while blocks < self.depth and self.readers:
             if self.readers[0].exhausted():
                 self.readers.pop(0)
                 continue
-            self.keys, self.vals = self.readers[0].next_block()
+            k, v = self.readers[0].next_block()
+            parts_k.append(k)
+            parts_v.append(v)
+            blocks += 1
+        if len(parts_k) == 1:
+            self.keys, self.vals = parts_k[0], parts_v[0]
+        elif parts_k:
+            # Within one stream blocks are already key-ordered end to end.
+            self.keys = np.concatenate(parts_k)
+            self.vals = np.concatenate(parts_v)
 
     def exhausted(self) -> bool:
         self.refill()
@@ -176,6 +217,13 @@ class _MergeStream:
 
     def last_buffered_lo(self) -> int:
         return int(self.keys[-1]["lo"])
+
+    def bound_lo(self, target_rows: int) -> int:
+        """A safe chunk bound ~target_rows into the buffer. Any buffered
+        key qualifies: the unbuffered remainder sorts past the tail, so
+        every row <= it is already here."""
+        i = min(max(target_rows, 1), len(self.keys)) - 1
+        return int(self.keys[i]["lo"])
 
 
 class DurableIndex:
@@ -231,9 +279,12 @@ class DurableIndex:
         # Compaction driver state: only ever touched between beats (store
         # context) or behind a full store barrier (checkpoint/restore).
         self._job: Optional["_CompactionJob"] = None  # tidy: owner=commit|store
-        # (level, captured input tables, reservation) of a fault-aborted
-        # job, recreated verbatim on retry.
+        # (level, captured input tables, reservation, owed, is_storm) of a
+        # fault-aborted job, recreated verbatim on retry.
         self._aborted_resv: Optional[tuple] = None  # tidy: owner=commit|store
+        # A queued-but-not-started major compaction storm (request_major):
+        # the next free compact_step beat plans it as one all-level job.
+        self._storm_requested = False  # tidy: owner=commit|store
         # Whole-table decoded-mirror LRU (see _decode_table). The lock
         # covers ONLY the LRU bookkeeping (list + row counter): the
         # commit thread's drain-free dup-confirm touches mirrors while
@@ -583,12 +634,14 @@ class DurableIndex:
                 # OTHER level's job is considered, or its reservation
                 # would leak and the eventual re-reserve would pick
                 # different indices.
-                level, tables, resv, p0 = self._aborted_resv
+                level, tables, resv, p0, storm = self._aborted_resv
                 self._aborted_resv = None
                 self._job = _CompactionJob(
-                    self, level, tables, reservation=resv
+                    self, level, tables, reservation=resv, is_storm=storm
                 )
                 self._job.pending_ff = p0
+            elif self._storm_requested:
+                self._plan_storm_job()
             else:
                 for level, tables in enumerate(self.levels):
                     if len(tables) > self.growth:
@@ -604,9 +657,20 @@ class DurableIndex:
             # owed forward is only consumed on SUCCESS: a fault mid-step
             # discards the step's merges, so the retry still owes it.
             quota = quota_entries + self._job.pending_ff
-            exhausted = self._job.step(quota)
+            if self._job.pending_ff:
+                with tracer.span("lsm.compact.forward"):
+                    exhausted = self._job.step(quota)
+            else:
+                exhausted = self._job.step(quota)
             self._job.pending_ff = 0
+            if self.name is not None and self._job.is_storm:
+                tracer.gauge(
+                    f"lsm.{self.name}.storm_remaining",
+                    max(0, self._job.total_rows - self._job.progress),
+                )
             if exhausted:
+                if self.name is not None and self._job.is_storm:
+                    tracer.gauge(f"lsm.{self.name}.storm_remaining", 0)
                 self._install_job()
         except GridReadFault:
             # A corrupt input block: the step is NOT resumable (streams
@@ -616,16 +680,108 @@ class DurableIndex:
             # kept, so the retried job forwards to the position peers
             # hold and stays install-op aligned.
             owed = self._job.progress_at_step_start + self._job.pending_ff
+            self._job.discard_pending()
             self._job.writer.abort()
             self._aborted_resv = (
                 self._job.level, self._job.tables, self._job.reservation,
-                owed,
+                owed, self._job.is_storm,
             )
             self._job = None
             raise
-        return self._job is not None or any(
-            len(t) > self.growth for t in self.levels
+        return (
+            self._job is not None
+            or self._storm_requested
+            or any(len(t) > self.growth for t in self.levels)
         )
+
+    def request_major(self) -> int:
+        """Queue a forced all-level major compaction (the reference's
+        compaction-storm shape) to run INCREMENTALLY through compact_step
+        beats, so the tree keeps serving lookups and inserts while the
+        whole keyspace merges down to one bottom run. Returns the rows
+        queued (0 if the tree is too small to bother, or a storm is
+        already queued/running).
+
+        Maintenance/single-node API: the request itself is not a
+        committed op, so a cluster must issue it identically on every
+        replica — but the storm JOB, once planned, checkpoints and
+        restores like any other compaction job."""
+        if self.storm_active():
+            return 0
+        self.flush_memtable()
+        if sum(len(lvl) for lvl in self.levels) < 2:
+            return 0
+        self._storm_requested = True
+        return sum(t.count for lvl in self.levels for t in lvl)
+
+    def storm_active(self) -> bool:
+        """True while a storm is queued, running, or awaiting fault retry."""
+        return (
+            self._storm_requested
+            or (self._job is not None and self._job.is_storm)
+            or (self._aborted_resv is not None and self._aborted_resv[4])
+        )
+
+    def _plan_storm_job(self) -> None:
+        """Start the queued storm as ONE beat-paced job over every table,
+        oldest-first across levels (deeper level = older data; append
+        order is age order within a level). The k-way merge folds ≤64
+        streams per pass in the C core and buffers one block per stream,
+        so even a whole-tree merge is O(tables) memory. Output becomes
+        the new bottom level at install. Runs only when no other job is
+        in flight — a regular job finishes first and its output joins
+        the storm's inputs."""
+        self._storm_requested = False
+        self.flush_memtable()
+        tables = [t for level in reversed(self.levels) for t in level]
+        if len(tables) < 2:
+            return
+        self._job = _CompactionJob(self, 0, tables, is_storm=True)
+
+    def compact_backlog(self) -> int:
+        """Entries of compaction work outstanding. This is the pacing
+        input for the adaptive beat quota, so it must be a pure function
+        of committed state: levels content and job progress are
+        beat-paced, and a fault-aborted job counts its owed position
+        (total − owed equals a non-faulting peer's total − progress), so
+        replicas and WAL replay compute identical backlogs."""
+        backlog = 0
+        j = self._job
+        if j is not None:
+            backlog += max(0, j.total_rows - j.progress - j.pending_ff)
+        elif self._aborted_resv is not None:
+            _lvl, tables, _resv, owed, _storm = self._aborted_resv
+            backlog += max(0, sum(t.count for t in tables) - owed)
+        elif self._storm_requested:
+            backlog += sum(t.count for lvl in self.levels for t in lvl)
+        for level, tables in enumerate(self.levels):
+            if len(tables) <= self.growth:
+                continue
+            # Tables captured by the running job still sit in their level;
+            # skip them rather than double-count (a storm captured all).
+            if j is not None and (j.is_storm or level == j.level):
+                continue
+            backlog += sum(t.count for t in tables)
+        return backlog
+
+    def compact_prefetch_one(self) -> bool:
+        """Warm ONE upcoming compaction-input block into the grid cache
+        (idle-slot read-ahead). Content-neutral: only cache temperature
+        changes, never merge order or output bytes, so it is safe to
+        drive from timing-dependent idle detection. Faults are swallowed
+        here — the real read takes the normal repair path. Storm jobs
+        only: routine level merges touch a handful of blocks per beat and
+        their inputs are usually still cache-hot from ingest, so the
+        read-ahead would mostly queue cold reads behind the WAL's writes
+        (which the commit path is latency-bound on); a storm's all-level
+        fold is the case where warm inputs pay for that contention."""
+        j = self._job
+        if j is None or not j.is_storm:
+            return False
+        try:
+            return j.prefetch_one()
+        except GridReadFault:
+            return False
 
     def _install_job(self) -> None:
         job = self._job
@@ -638,13 +794,28 @@ class DurableIndex:
         # reader walking newest-first always finds every entry in at
         # least one of the two (merges preserve content; transient double
         # visibility resolves to the same values).
-        if job.level + 1 >= len(self.levels):
-            self.levels.append([])
-        self.levels[job.level + 1].extend(out)
         captured = set(id(t) for t in job.tables)  # tidy: allow=id-key — identity membership within one process, never ordered or serialized
-        self.levels[job.level] = [
-            t for t in self.levels[job.level] if id(t) not in captured  # tidy: allow=id-key — identity membership within one process, never ordered or serialized
-        ]
+        if job.is_storm:
+            # Storm install: the merged run becomes the new BOTTOM level,
+            # every captured input (which spanned all levels) retires, and
+            # emptied interior levels compress away — level indices are
+            # not persisted identities, and no other job is in flight.
+            self.levels.append(out)
+            self.levels = [
+                [t for t in lvl if id(t) not in captured]  # tidy: allow=id-key — identity membership within one process, never ordered or serialized
+                for lvl in self.levels
+            ]
+            self.levels = [self.levels[0]] + [
+                lvl for lvl in self.levels[1:] if lvl
+            ]
+            tracer.count("lsm.compaction_storms")
+        else:
+            if job.level + 1 >= len(self.levels):
+                self.levels.append([])
+            self.levels[job.level + 1].extend(out)
+            self.levels[job.level] = [
+                t for t in self.levels[job.level] if id(t) not in captured  # tidy: allow=id-key — identity membership within one process, never ordered or serialized
+            ]
         for t in job.tables:
             self._release_table(t)
         tracer.count("lsm.compaction_installs")
@@ -702,10 +873,18 @@ class DurableIndex:
     def compact_all(self) -> None:
         """Forced major compaction: merge every level into one bottom run
         (the reference's compaction-storm shape, BASELINE config 5).
-        Hierarchical k-way: groups of ≤16 streams per pass (bounded
-        buffered memory), so t tables cost ~log₁₆(t) passes instead of the
-        old pairwise fold's t passes."""
-        self.drain_compaction()
+        Hierarchical k-way: groups of ≤64 streams per pass — the C
+        merge core's heap selection is O(log k) per row, so the wide
+        group costs the same per row as a narrow one but a whole
+        benchmark-scale tree collapses in ONE pass (every row moves
+        once) where the old 16-wide grouping needed two."""
+        # Finish only the IN-FLIGHT job (a manifest must never reference
+        # a half-written merge) — but do NOT drain_compaction(): that
+        # would plan fresh level merges whose whole output the all-level
+        # fold below immediately re-merges, doubling every row's moves.
+        # The big fold absorbs any queued level work in the same pass.
+        while self._job is not None or self._aborted_resv is not None:
+            self.compact_step(1 << 62)
         self.flush_memtable()
         # Oldest-first: deeper levels hold older data; within a level,
         # append order is age order. Group merges keep age order because
@@ -715,10 +894,10 @@ class DurableIndex:
             t for level in reversed(self.levels) for t in level
         ]
         while len(tables) > 1:
-            one_group = len(tables) <= 16
+            one_group = len(tables) <= 64
             next_round: List[TableInfo] = []
-            for g in range(0, len(tables), 16):
-                group = tables[g : g + 16]
+            for g in range(0, len(tables), 64):
+                group = tables[g : g + 64]
                 if len(group) == 1:
                     next_round.extend(group)
                     continue
@@ -733,6 +912,9 @@ class DurableIndex:
             if one_group:
                 break  # a single merge's outputs are already disjoint
         self.levels = [[], tables]
+        # The fold above IS a completed major: a still-queued storm
+        # request would only re-merge the single bottom run.
+        self._storm_requested = False
 
     # --- read path ------------------------------------------------------
 
@@ -1070,6 +1252,17 @@ class DurableIndex:
         if j is None:
             return None
         n = len(j.tables)
+        if j.is_storm:
+            # A storm job's inputs span EVERY level, oldest-first — and
+            # stay a prefix of that order across checkpoints, because
+            # flushes only APPEND to level 0 (newest position) while the
+            # storm runs and no other job restructures levels. The
+            # sentinel level tells restore_job to rebuild the same list.
+            flat = [t for level in reversed(self.levels) for t in level]
+            assert flat[:n] == j.tables, (
+                "storm inputs must be the oldest-first prefix across levels"
+            )
+            return (_STORM_LEVEL, n, j.progress, list(j.reservation))
         assert self.levels[j.level][:n] == j.tables, (
             "job inputs must be a prefix of their level"
         )
@@ -1090,12 +1283,29 @@ class DurableIndex:
         crossing), so the restarted job installs at the same future op
         as a replica that never restarted — and a fault during the
         forward takes compact_step's abort path like any other."""
-        tables = self.levels[level][:n_inputs]
+        storm = level == _STORM_LEVEL
+        if storm:
+            flat = [t for lvl in reversed(self.levels) for t in lvl]
+            tables = flat[:n_inputs]
+        else:
+            tables = self.levels[level][:n_inputs]
         assert len(tables) == n_inputs
         self._job = _CompactionJob(
-            self, level, tables, reservation=list(reservation)
+            self, 0 if storm else level, tables,
+            reservation=list(reservation), is_storm=storm,
         )
         self._job.pending_ff = progress
+
+    def storm_state(self) -> int:
+        """1 if a storm is queued but not yet planned as a job (the
+        request_major → first-beat window), for checkpoint persistence.
+        A PLANNED storm persists via job_state's sentinel instead."""
+        return 1 if self._storm_requested else 0
+
+    def restore_storm(self, requested: int) -> None:
+        """Re-queue a checkpointed not-yet-planned storm request. Call
+        BEFORE restore_job (a restored job descriptor supersedes it)."""
+        self._storm_requested = bool(requested)
 
     def restore(self, manifest: np.ndarray) -> None:  # tidy: allow=unlocked-access — open/state-sync path: stages are reset/quiesced, no concurrent reader exists
         self._mem = []
@@ -1105,6 +1315,7 @@ class DurableIndex:
         self.count = 0
         self._job = None
         self._aborted_resv = None
+        self._storm_requested = False
         self._decoded_lru = []
         self._decoded_rows = 0
         for rec in manifest:
@@ -1130,12 +1341,20 @@ class _CompactionJob:
 
     def __init__(
         self, tree: DurableIndex, level: int, tables: List[TableInfo],
-        reservation: Optional[List[int]] = None,
+        reservation: Optional[List[int]] = None, is_storm: bool = False,
     ) -> None:
         self.tree = tree
         self.level = level
         self.tables = tables
-        self.streams = [_MergeStream(tree, [t]) for t in tables]
+        self.is_storm = is_storm
+        # Read-ahead depth budget: ~2M buffered rows across all streams
+        # (≈40 MB at benchmark block sizes, transient, small next to the
+        # decoded-mirror budget) — wide merges get multi-block chunks
+        # without unbounded memory. Deterministic: a pure function of the
+        # captured table count and the grid geometry.
+        depth = max(1, min(8, (1 << 21) // max(1, len(tables) * tree.entries_per_block)))
+        self.streams = [_MergeStream(tree, [t], depth=depth) for t in tables]
+        self.total_rows = sum(t.count for t in tables)
         if reservation is None:
             # Reserve the EXACT output block count up front (merges
             # preserve entry counts): the job owns these blocks privately,
@@ -1143,13 +1362,31 @@ class _CompactionJob:
             # restarts the job from its checkpointed descriptor writes
             # the same content at the same indices (reference
             # free_set.zig:28-45 reservations).
-            total = sum(t.count for t in tables)
             epb = tree.entries_per_block
-            n_data = -(-total // epb)
+            n_data = -(-self.total_rows // epb)
             n_index = -(-n_data // tree.fences_per_index)
             reservation = tree.grid.free_set.reserve(n_data + n_index)
         self.reservation = reservation
-        self.writer = _TableWriter(tree, reservation)
+        # Fused Bloom plan: output table boundaries are known UP FRONT
+        # (merges preserve counts; every data block except the run's last
+        # is epb-full, so tables split at exact multiples of span), so
+        # per-table filters sized exactly as the lazy builders would size
+        # them (2*count) can be populated inside the merge's output pass
+        # — the filters are bit-identical to a post-hoc build, and the
+        # first-probe full-table scan (_stream_bloom) never runs for
+        # compacted tables.
+        self._span = tree.fences_per_index * tree.entries_per_block
+        n_tables = -(-self.total_rows // self._span) if self.total_rows else 0
+        self._blooms = [
+            Bloom(2 * min(self._span, self.total_rows - t * self._span))
+            for t in range(n_tables)
+        ]
+        self._out_pos = 0
+        # Split-phase double buffer: a dispatched-but-unmaterialized
+        # device merge chunk (flushed in dispatch order; never outlives
+        # one step call).
+        self._pending = None
+        self.writer = _TableWriter(tree, reservation, blooms=self._blooms)
         # Cumulative entries merged — persisted with the checkpoint
         # descriptor so a restarted replica fast-forwards to the SAME
         # position and installs at the same op as peers that kept
@@ -1167,51 +1404,143 @@ class _CompactionJob:
         """Merge ≥1 chunk, up to ~quota_entries; True when exhausted."""
         self.progress_at_step_start = self.progress
         merged = 0
+        use_device = False
+        if self.tree.backend == "jax":
+            from tigerbeetle_tpu.ops import merge as merge_ops
+
+            use_device = merge_ops.device_merge_pays()
         while merged < quota_entries:
             live = [s for s in self.streams if not s.exhausted()]
             if not live:
+                self._flush_pending()
                 return True
             if len(live) == 1:
                 k, v = live[0].take(None)
-                self.writer.append(k, v)
+                self._append(k, v)
                 merged += len(k)
                 self.progress += len(k)
                 continue
             # Everything at or below the smallest buffered tail key can be
-            # ordered now — later input in any stream sorts past it.
-            bound = min(s.last_buffered_lo() for s in live)
+            # ordered now — later input in any stream sorts past it. Cut
+            # near the remaining quota so beats stay bounded even with
+            # deep read-ahead buffers; drain-style quotas (compact_all,
+            # storm drain) degenerate to the full-buffer bound.
+            per = max(1, (quota_entries - merged) // len(live))
+            bound = min(s.bound_lo(per) for s in live)
             parts_k, parts_v = [], []
             for s in live:  # oldest-first order
                 k, v = s.take(bound)
                 if len(k):
                     parts_k.append(k)
                     parts_v.append(v)
-            ck, cv = self._combine(parts_k, parts_v)
-            self.writer.append(ck, cv)
-            merged += len(ck)
-            self.progress += len(ck)
+            n_chunk = sum(len(k) for k in parts_k)
+            if use_device and len(parts_k) > 1:
+                # Split-phase: dispatch THIS chunk's device fold before
+                # materializing the PREVIOUS one, so the device merge
+                # overlaps the previous chunk's host-side bloom feed and
+                # table build (the streaming engine's double buffer).
+                # Chunks append strictly in dispatch order, so output
+                # bytes are identical to the synchronous path.
+                from tigerbeetle_tpu.ops import merge as merge_ops
+
+                with tracer.span("lsm.compact.merge"):
+                    handle = merge_ops.compact_fold_dispatch(
+                        parts_k, parts_v
+                    )
+                self._flush_pending()
+                self._pending = handle
+            else:
+                with tracer.span("lsm.compact.merge"):
+                    ck, cv, prefilled = self._combine(parts_k, parts_v)
+                self._append(ck, cv, prefilled=prefilled)
+            merged += n_chunk
+            self.progress += n_chunk
+        self._flush_pending()
         return False
 
     def _combine(
         self, parts_k: List[np.ndarray], parts_v: List[np.ndarray]
-    ) -> Tuple[np.ndarray, np.ndarray]:
+    ) -> Tuple[np.ndarray, np.ndarray, bool]:
+        """Host k-way combine → (keys, vals, bloom_prefilled)."""
         if len(parts_k) == 1:
-            return parts_k[0], parts_v[0]
-        if self.tree.backend == "jax":
-            from tigerbeetle_tpu.ops import merge as merge_ops
-
-            if merge_ops.device_merge_pays():
-                # Chip-colocated hosts fold the chunk through the device
-                # merge-path kernel (ops/merge.py) pairwise — each part is
-                # sorted, and the fold keeps older parts on the A side.
-                mk, mv = parts_k[0], parts_v[0]
-                for k, v in zip(parts_k[1:], parts_v[1:]):
-                    mk, mv = self.tree._merge_chunk(mk, mv, k, v)
-                return mk, mv
+            return parts_k[0], parts_v[0], False
         # Host path: each part is sorted and parts arrive oldest-first,
         # so the stable galloping k-way merge (C shim) produces the
-        # radix sort's exact bytes at merge cost instead of sort cost.
-        return merge_host_kway(parts_k, parts_v)
+        # radix sort's exact bytes at merge cost instead of sort cost —
+        # and the fused variant sets the output tables' Bloom bits on the
+        # rows while they are cache-hot from the copy, erasing the
+        # separate build pass.
+        if self._blooms:
+            ends, blooms = self._segments(sum(len(k) for k in parts_k))
+            mk, mv = merge_host_kway_bloom(parts_k, parts_v, ends, blooms)
+            return mk, mv, True
+        mk, mv = merge_host_kway(parts_k, parts_v)
+        return mk, mv, False
+
+    def _segments(
+        self, n: int
+    ) -> Tuple[List[int], List[Optional[Bloom]]]:
+        """Output-table boundary splits of the next n output rows,
+        relative to the chunk start (the fused merge's segment plan)."""
+        pos = self._out_pos
+        ends: List[int] = []
+        blooms: List[Optional[Bloom]] = []
+        while n > 0:
+            t = pos // self._span
+            take = min(self._span - pos % self._span, n)
+            ends.append(pos + take - self._out_pos)
+            blooms.append(self._blooms[t] if t < len(self._blooms) else None)
+            pos += take
+            n -= take
+        return ends, blooms
+
+    def _append(
+        self, keys: np.ndarray, vals: np.ndarray, prefilled: bool = False
+    ) -> None:
+        """Feed output rows to the writer, populating table Blooms for
+        any path that did not fuse them (single-stream passthrough,
+        device-fold chunks). Flushes a pending device chunk first so
+        output rows land in merge order."""
+        self._flush_pending()
+        if len(keys) == 0:
+            return
+        if not prefilled and self._blooms:
+            with tracer.span("lsm.compact.bloom"):
+                ends, blooms = self._segments(len(keys))
+                _bloom_fill(keys, ends, blooms)
+        self._out_pos += len(keys)
+        with tracer.span("lsm.compact.build"):
+            self.writer.append(keys, vals)
+
+    def _flush_pending(self) -> None:
+        """Materialize + append the previously dispatched device chunk
+        (the back half of the split-phase double buffer)."""
+        if self._pending is None:
+            return
+        from tigerbeetle_tpu.ops import merge as merge_ops
+
+        handle, self._pending = self._pending, None
+        with tracer.span("lsm.compact.merge"):
+            k, v = merge_ops.compact_fold_materialize(handle)
+        self._append(k, v)
+
+    def discard_pending(self) -> None:
+        """Drop a dispatched-but-unappended device chunk (fault abort
+        path): closes its tracer dispatch token; the retried job simply
+        re-merges the chunk."""
+        if self._pending is None:
+            return
+        handle, self._pending = self._pending, None
+        tracer.device_finish("compact_fold", handle[3])
+
+    def prefetch_one(self) -> bool:
+        """Warm one upcoming input block (idle read-ahead); see
+        DurableIndex.compact_prefetch_one."""
+        for stream in self.streams:
+            for reader in stream.readers:
+                if reader.prefetch_block():
+                    return True
+        return False
 
 
 class _TableWriter:
@@ -1226,7 +1555,10 @@ class _TableWriter:
     restarted from scratch (crash recovery) writes byte-identical blocks
     at identical indices no matter what else allocated in between."""
 
-    def __init__(self, tree: DurableIndex, reservation: Optional[List[int]] = None) -> None:
+    def __init__(
+        self, tree: DurableIndex, reservation: Optional[List[int]] = None,
+        blooms: Optional[List[Bloom]] = None,
+    ) -> None:
         self.tree = tree
         self.reservation = reservation
         self._resv_next = 0
@@ -1236,6 +1568,10 @@ class _TableWriter:
         self.fences: List[tuple] = []
         self.total = 0
         self.done: List[TableInfo] = []
+        # Per-output-table Bloom filters populated by the owning
+        # compaction job's merge passes (ordinal == position in `done`);
+        # attached at table close so the lazy builders never run.
+        self._blooms = blooms
 
     def _write(self, payload: bytes, block_type: int) -> int:
         if self.reservation is None:
@@ -1269,18 +1605,32 @@ class _TableWriter:
     def append(self, keys: np.ndarray, vals: np.ndarray) -> None:
         if len(keys) == 0:
             return
-        self.parts_k.append(keys)
-        self.parts_v.append(vals)
-        self.buffered += len(keys)
         epb = self.tree.entries_per_block
-        if self.buffered >= epb:
-            k = np.concatenate(self.parts_k)
-            v = np.concatenate(self.parts_v)
-            while len(k) >= epb:
-                self._flush_block(k[:epb], v[:epb])
-                k, v = k[epb:], v[epb:]
-            self.parts_k, self.parts_v = [k], [v]
-            self.buffered = len(k)
+        if self.buffered:
+            if self.buffered + len(keys) < epb:
+                self.parts_k.append(keys)
+                self.parts_v.append(vals)
+                self.buffered += len(keys)
+                return
+            # Only the leftover-completion pays a concatenate; full
+            # blocks below are sliced straight out of the chunk.
+            need = epb - self.buffered
+            self._flush_block(
+                np.concatenate(self.parts_k + [keys[:need]]),
+                np.concatenate(self.parts_v + [vals[:need]]),
+            )
+            keys, vals = keys[need:], vals[need:]
+            self.parts_k, self.parts_v, self.buffered = [], [], 0
+        n_full = len(keys) // epb
+        for i in range(n_full):
+            self._flush_block(
+                keys[i * epb:(i + 1) * epb], vals[i * epb:(i + 1) * epb]
+            )
+        rem = len(keys) - n_full * epb
+        if rem:
+            self.parts_k = [keys[n_full * epb:]]
+            self.parts_v = [vals[n_full * epb:]]
+            self.buffered = rem
 
     def _flush_block(self, keys: np.ndarray, vals: np.ndarray) -> None:
         payload = (
@@ -1309,12 +1659,17 @@ class _TableWriter:
             + fences.tobytes()
         )
         index_block = self._write(index_payload, BLOCK_TYPE_INDEX)
+        bloom = None
+        if self._blooms is not None and len(self.done) < len(self._blooms):
+            bloom = self._blooms[len(self.done)]
+            tracer.count("lsm.compact.bloom_tables_fused")
         self.done.append(
             TableInfo(
                 index_block=index_block,
                 count=self.total,
                 key_min=(int(fences[0]["first_hi"]), int(fences[0]["first_lo"])),
                 key_max=(int(fences[-1]["last_hi"]), int(fences[-1]["last_lo"])),
+                bloom=bloom,
                 _fences=fences,
             )
         )

@@ -307,6 +307,10 @@ class StateMachine:
         # Resume point within compact_beat's stage list after a
         # GridReadFault was repaired (see compact_beat).
         self._beat_stage = 0  # tidy: owner=commit|store — advanced only inside the per-op beat, which runs in exactly one context per op
+        # Event count of the last committed batch — the adaptive beat
+        # quota's load signal (a pure function of the committed op
+        # stream, so replicas and WAL replay pace identically).
+        self._beat_events = 0  # tidy: owner=commit|store — written by the op apply, read by its own beat
 
         # Split-phase device dispatch (the overlapped commit pipeline,
         # vsr/pipeline.py): FIFO of outstanding handles whose kernels are
@@ -606,7 +610,8 @@ class StateMachine:
         # the RETRY after repair resumes at the faulted stage — re-running
         # completed stages would give their trees extra beats for this op
         # and diverge the deterministic allocation order from peers.
-        quota = self.config.compact_quota_entries
+        quota = self._compact_quota()
+        tracer.gauge("sm.compact.quota", quota)
         stages = (
             lambda: self.transfer_log.flush_pending(max_blocks),
             lambda: self.history.flush_pending(max_blocks),
@@ -621,6 +626,81 @@ class StateMachine:
                 stages[self._beat_stage]()
                 self._beat_stage += 1
             self._beat_stage = 0
+
+    def _compact_quota(self) -> int:
+        """Adaptive beat quota: scale the per-op compaction allowance by
+        committed-state signals only — the last batch's fill fraction
+        (commits stalling on store.wait arrive as full batches; idle
+        trickle arrives small) and the trees' compaction backlog. Both
+        inputs are pure functions of the committed op stream, so every
+        replica (and WAL replay) computes the identical quota per op and
+        grid allocation order stays byte-deterministic — the reason the
+        quota must NOT read wall-clock queue depth, which differs per
+        machine."""
+        base = self.config.compact_quota_entries
+        backlog = self._compact_backlog()
+        if backlog == 0:
+            return base
+        if backlog >= base << 3:
+            # Far behind (a storm, or a stalled stretch): catch up hard —
+            # commits momentarily pay more per op, which is cheaper than
+            # the read-amplification of an over-deep tree.
+            return base << 2
+        fill = self._beat_events / self.config.batch_max
+        if fill >= 0.5:
+            # Saturated ingest: halve the allowance so the beat stays off
+            # the commit path's critical section (backlog above bounds
+            # how long the back-off can run).
+            return base >> 1
+        if fill <= 0.125:
+            return base << 2  # mostly idle: drain the backlog
+        return base
+
+    def _compact_backlog(self) -> int:
+        return (
+            self.transfer_index.compact_backlog()
+            + self.account_rows.compact_backlog()
+            + self.query_rows.compact_backlog()
+            + self.posted.compact_backlog()
+            + self.history.compact_backlog()
+        )
+
+    def request_major_compaction(self) -> int:
+        """Queue a forced all-level major compaction (storm) on every
+        content tree; returns total rows queued. The storms then run
+        incrementally through the normal per-op beats while the machine
+        keeps serving. Maintenance/single-node API — see
+        DurableIndex.request_major for the cluster caveat."""
+        self.store_barrier()
+        self.flush_deferred()
+        return (
+            self.transfer_index.request_major()
+            + self.account_rows.request_major()
+            + self.query_rows.request_major()
+            + self.posted.request_major()
+            + self.history.request_major()
+        )
+
+    def compaction_storm_active(self) -> bool:
+        return (
+            self.transfer_index.storm_active()
+            or self.account_rows.storm_active()
+            or self.query_rows.storm_active()
+            or self.posted.storm_active()
+            or self.history.storm_active()
+        )
+
+    def compact_prefetch_one(self) -> bool:
+        """Warm one upcoming compaction-input block (idle-slot read-ahead
+        driven by the store stage; content-neutral, see
+        DurableIndex.compact_prefetch_one)."""
+        for tree in (
+            self.transfer_index, self.account_rows, self.query_rows,
+            self.posted, self.history,
+        ):
+            if tree.compact_prefetch_one():
+                return True
+        return False
 
     # ------------------------------------------------------------------
     # balances access (device or host backend)
@@ -724,6 +804,7 @@ class StateMachine:
         self.flush_deferred()
         events = np.atleast_1d(events)
         n = len(events)
+        self._beat_events = n
         if timestamp is None:
             timestamp = self.prepare("create_accounts", n)
         if n == 0:
@@ -900,6 +981,7 @@ class StateMachine:
         self.flush_deferred()
         events = np.atleast_1d(events)
         n = len(events)
+        self._beat_events = n
         if timestamp is None:
             timestamp = self.prepare("create_transfers", n)
         if n == 0:
@@ -1157,6 +1239,7 @@ class StateMachine:
         )
         self._ct_pending.pop(0)
         events, timestamp, n = handle["events"], handle["timestamp"], handle["n"]
+        self._beat_events = n
         if handle["gen"] != self._state_gen:
             # An earlier batch in the chain bailed and rolled the state
             # token back: this kernel consumed a revoked token — discard

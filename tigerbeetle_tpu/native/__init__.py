@@ -142,6 +142,17 @@ def hostops() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int64), u64p, u32p,
         ]
         lib.hostops_merge_kv.restype = ctypes.c_int
+    # Fused merge + segmented Bloom build (round-16 streaming compaction).
+    # Same stale-.so guard: older libraries fall back to the two-pass path.
+    if hasattr(lib, "hostops_merge_kv_bloom"):
+        lib.hostops_merge_kv_bloom.argtypes = [
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_int64), u64p, u32p,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_void_p), u64p,
+        ]
+        lib.hostops_merge_kv_bloom.restype = ctypes.c_int
     # The C staging ladder hardcodes the wire-contract result codes; refuse
     # the shim (fall back to numpy) if the enums ever drift.
     from tigerbeetle_tpu.results import CreateTransferResult as _TR

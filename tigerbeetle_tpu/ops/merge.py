@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tigerbeetle_tpu import tracer
 from tigerbeetle_tpu.ops import u128
 
 I32 = jnp.int32
@@ -276,6 +277,81 @@ def merge_device(keys_a, vals_a, keys_b, vals_b):
     kb, pb = to_device_run(keys_b, vals_b)
     ok, op = merge_kernel_tiled(ka, pa, kb, pb)
     return from_device_run(ok, op, n + m)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def compact_fold_kernel(keys_stack, pays_stack):
+    """Whole-chunk k-way compaction fold in ONE dispatch: (k, b, 3)
+    stacked sorted runs → one merged (k·b, 3) run, folded pairwise
+    through merge_kernel_tiled inside this trace (traced inner jit calls
+    are one compile, not k). k and b are both pow-2 (callers pad via
+    _stack_pow2), so compile count is bounded by the handful of
+    (k-bucket, b-bucket) pairs a compaction quota can produce — the
+    steady_compiles exact gate stays green. Stability: runs are stacked
+    oldest-first and every pairwise merge keeps A-side (earlier) rows
+    first at equal keys, so the tree fold preserves the global
+    oldest-first order."""
+    k = keys_stack.shape[0]
+    keys = [keys_stack[i] for i in range(k)]
+    pays = [pays_stack[i] for i in range(k)]
+    while len(keys) > 1:
+        nk, npay = [], []
+        for i in range(0, len(keys), 2):
+            ok, op = merge_kernel_tiled(keys[i], pays[i], keys[i + 1], pays[i + 1])
+            nk.append(ok)
+            npay.append(op)
+        keys, pays = nk, npay
+    return keys[0], pays[0]
+
+
+def _stack_pow2(parts_k, parts_v):
+    """Host KEY_DTYPE runs → the fold kernel's stacked ((k_pad, b, 3)
+    keys, (k_pad, b, 3) payload) layout: every run padded to ONE common
+    pow-2 bucket b (pad rows set the pad-flag limb, sorting strictly
+    last), the run list padded to a pow-2 count with all-pad runs.
+    Returns (keys, payload, total_real_rows)."""
+    k = len(parts_k)
+    k_pad = 1 << max(0, (k - 1).bit_length())
+    b = bucket_pow2(max(len(p) for p in parts_k))
+    ks = np.zeros((k_pad, b, 3), dtype=np.uint32)
+    ks[:, :, 2] = 1
+    ps = np.zeros((k_pad, b, 3), dtype=np.uint32)
+    total = 0
+    for i, (pk, pv) in enumerate(zip(parts_k, parts_v)):
+        n = len(pk)
+        total += n
+        ks[i, :n, 0] = pk["lo"] & np.uint64(0xFFFFFFFF)
+        ks[i, :n, 1] = pk["lo"] >> np.uint64(32)
+        ks[i, :n, 2] = 0
+        ps[i, :n, 0] = pk["hi"] & np.uint64(0xFFFFFFFF)
+        ps[i, :n, 1] = pk["hi"] >> np.uint64(32)
+        ps[i, :n, 2] = pv
+    return ks, ps, total
+
+
+def compact_fold_dispatch(parts_k, parts_v):
+    """Stage + dispatch one compaction chunk's k-way fold; NO device→host
+    sync — the split-phase front half of the streaming compaction engine
+    (the handle is resolved by compact_fold_materialize, typically one
+    chunk later so the transfer overlaps the next chunk's merge)."""
+    ks, ps, total = _stack_pow2(parts_k, parts_v)
+    t_disp = tracer.device_dispatch(
+        "compact_fold", h2d_bytes=ks.nbytes + ps.nbytes
+    )
+    keys_dev, pays_dev = compact_fold_kernel(ks, ps)
+    return keys_dev, pays_dev, total, t_disp
+
+
+def compact_fold_materialize(handle):
+    """Sync + strip a compact_fold_dispatch handle (sanctioned seam, the
+    chunk-append boundary): (KEY_DTYPE keys, u32 vals) of the real rows."""
+    keys_dev, pays_dev, total, t_disp = handle
+    ok = np.asarray(keys_dev)
+    op = np.asarray(pays_dev)
+    tracer.device_finish(
+        "compact_fold", t_disp, d2h_bytes=ok.nbytes + op.nbytes
+    )
+    return from_device_run(ok.reshape(-1, 3), op.reshape(-1, 3), total)
 
 
 # Host-side stable k-way merge: lives in lsm/store.py (jax-free, next to

@@ -106,6 +106,7 @@ JIT_ENTRIES = {
     "read_balances": (),
     "merge_kernel": (),
     "merge_kernel_tiled": ("tile",),
+    "compact_fold_kernel": (),
     "query_index_keys": (),
     "query_index_keys_sorted": (),
 }
@@ -127,13 +128,16 @@ JAXLINT_SYNC_SEAM = frozenset((
     # table-build boundary (lsm/tree._flush_sorted_kv).
     ("tigerbeetle_tpu/ops/qindex.py", "QueryKeyRun.materialize"),
     ("tigerbeetle_tpu/ops/qindex.py", "materialize_fold"),
+    # The streaming-compaction device fold's only sync point: the back
+    # half of the split-phase double buffer (_CompactionJob._flush_pending).
+    ("tigerbeetle_tpu/ops/merge.py", "compact_fold_materialize"),
 ))
 
 # Functions whose results count as shape-stabilized (bucket-padded):
 # jit-entry arguments produced by these escape the retrace-shape rule.
 JAXLINT_PAD_HELPERS = frozenset((
-    "_device_batch", "_pad_pow2", "_pad_slots", "pad1", "p1",
-    "stage_query_batch", "to_device_run",
+    "_device_batch", "_pad_pow2", "_pad_slots", "_stack_pow2", "pad1",
+    "p1", "stage_query_batch", "to_device_run",
 ))
 
 # --- absint: limb-width abstract interpretation scope --------------------

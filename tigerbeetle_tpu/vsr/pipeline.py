@@ -261,12 +261,17 @@ class StoreExecutor:
         self._post = post
         self._notify = notify if notify is not None else (lambda: None)
         self._depth_max = depth_max
-        # Optional queue-idle poll (device query-index pipeline): called
-        # with the lock RELEASED while the queue is empty; returns True
-        # while it may have more to do. Must be content-neutral and
-        # idempotent — it only pulls deferred device→host transfers
-        # forward (QueryKeyRun.materialize), never changes state bytes —
-        # so it needs no drain()/barrier coordination.
+        # Optional queue-idle poll (device query-index pipeline and
+        # compaction read-ahead): called with the lock RELEASED while the
+        # queue is empty; returns True while it may have more to do. Must
+        # be content-neutral and idempotent — it only pulls deferred
+        # device→host transfers forward (QueryKeyRun.materialize) or
+        # warms upcoming compaction-input blocks into the grid cache
+        # (sm.compact_prefetch_one), never changes state bytes — so it
+        # needs no drain()/barrier coordination. This is the sanctioned
+        # place for TIMING-dependent acceleration: anything that would
+        # alter bytes (like the compaction quota) must key off committed
+        # state instead.
         self._idle_work = idle_work
         self._cond = tidy_runtime.make_condition("store.cond")
         self._pending: deque = deque()  # tidy: guarded-by=_cond

@@ -186,12 +186,15 @@ def encode(replica) -> bytes:
         reply_blobs.append(raw)
 
     sections = dict(
-        # v6: client_table gains last_op (front-door LRU eviction order,
-        # ISSUE 9). v5: config_epoch/slot_epochs (r5), qi query tree,
-        # per-tree compaction-job descriptors. No migration path between
-        # versions — data files are not carried across builds; the bump
-        # is diagnostic.
-        version=np.uint32(6),
+        # v7: per-tree storm-request flags (queued-but-unplanned major
+        # compactions; a PLANNED storm persists through the job
+        # descriptor's sentinel level). v6: client_table gains last_op
+        # (front-door LRU eviction order, ISSUE 9). v5:
+        # config_epoch/slot_epochs (r5), qi query tree, per-tree
+        # compaction-job descriptors. No migration path between versions
+        # — data files are not carried across builds; the bump is
+        # diagnostic.
+        version=np.uint32(7),
         account_count=np.int64(count),
         acc_key_hi=sm.acc_key["hi"][:count], acc_key_lo=sm.acc_key["lo"][:count],
         acc_ud128_lo=sm.acc_user_data_128_lo[:count],
@@ -237,6 +240,12 @@ def encode(replica) -> bytes:
         )
         sections[f"{name}_job_resv"] = np.array(
             st[3] if st is not None else [], dtype=np.uint32
+        )
+        # A storm queued but not yet planned as a job (request_major →
+        # first-beat window): the flag must survive the checkpoint or a
+        # restarted replica would silently drop the forced major.
+        sections[f"{name}_storm"] = np.array(
+            [tree.storm_state()], dtype=np.uint64
         )
         ref.extend(
             t.index_block for level in tree.levels for t in level
@@ -403,6 +412,11 @@ def install(replica, blob: bytes, rebuild_bloom: bool = True,
     for name, tree in content_trees(sm):
         tree.restore(z[f"{name}_manifest"])
         tree.attach_fences(z[f"{name}_fences"], z[f"{name}_fence_counts"])
+        # Storm flag BEFORE the job descriptor: a restored (planned)
+        # storm job supersedes a stale request, never the reverse.
+        storm = z.get(f"{name}_storm")
+        if storm is not None and len(storm):
+            tree.restore_storm(int(storm[0]))
         job = z[f"{name}_job"]
         if len(job):
             tree.restore_job(

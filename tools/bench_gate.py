@@ -116,6 +116,21 @@ GATED = (
     ("end_to_end", "store_query_ms_per_batch", False),
     ("config5_lsm", "ingest_rows_per_s", True),
     ("config5_lsm", "major_compaction_rows_per_s", True),
+    # Streaming compaction under load (ISSUE 16, docs/COMMIT_PIPELINE.md
+    # "Streaming compaction"): a forced all-level storm drained through
+    # the per-op beats while the same state machine serves an open-loop
+    # transfer stream. The fold rate (rows queued / wall time to drain,
+    # serving included) is higher-better; the serving dip while the
+    # storm ran lower-better — gated together so a "faster" storm that
+    # starves commits (or a gentler one that never finishes) both fail.
+    # Absent from pre-PR-16 baselines: n/a, not failure; a crashed
+    # sub-section records neither key → MISSING → fail-closed. The
+    # bloom_build_ms_per_table / serving_tx_per_s_* fields are recorded
+    # but NOT gated (the bloom pass measures the work fusion REMOVED —
+    # its absolute cost tracks table size, not code quality — and both
+    # serving rates already gate through the dip).
+    ("config5_lsm", "compaction_under_load.major_compaction_rows_per_s", True),
+    ("config5_lsm", "compaction_under_load.e2e_dip_pct", False),
     # Recovery-time objectives (bench.py `recovery` section: the chaos
     # scenarios of testing/chaos.py, docs/CHAOS.md). Keys are dotted
     # paths into the per-scenario blocks. Lower is better for both: how
