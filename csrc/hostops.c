@@ -461,6 +461,77 @@ int hostops_merge_kv(
                                   keys_out, vals_out, 0, 0, 0, 0);
 }
 
+/* ------------------------------------------- sorted-set row intersects */
+
+/* First index >= key in a[lo..n), found by galloping (doubling) from lo
+ * then binary search inside the located block — O(log gap) instead of
+ * O(log n), which is what makes probing a long run with a short sorted
+ * candidate list cheap (scan_merge.zig's probe(), re-shaped for arrays). */
+static inline int64_t gallop_lower_u32(
+    const uint32_t *a, int64_t lo, int64_t n, uint32_t key
+) {
+    int64_t step = 1, hi = lo;
+    while (hi < n && a[hi] < key) {
+        lo = hi + 1;
+        hi += step;
+        step <<= 1;
+    }
+    if (hi > n) hi = n;
+    /* invariant: a[lo-1] < key (or lo at start), a[hi] >= key (or hi==n) */
+    while (lo < hi) {
+        int64_t mid = lo + ((hi - lo) >> 1);
+        if (a[mid] < key) lo = mid + 1; else hi = mid;
+    }
+    return lo;
+}
+
+/* Intersection of two ascending u32 arrays (dups allowed in either; the
+ * output is the unique common values, ascending). Gallops on whichever
+ * side is ahead, so cost is O(min(na, nb) * log(gap)) — the short side
+ * drives. Returns the output count (out must hold min(na, nb)). */
+int64_t hostops_intersect_u32(
+    int64_t na, const uint32_t *a, int64_t nb, const uint32_t *b,
+    uint32_t *out
+) {
+    int64_t i = 0, j = 0, k = 0;
+    while (i < na && j < nb) {
+        uint32_t va = a[i], vb = b[j];
+        if (va == vb) {
+            out[k++] = va;
+            while (i < na && a[i] == va) i++;
+            while (j < nb && b[j] == vb) j++;
+        } else if (va < vb) {
+            i = gallop_lower_u32(a, i + 1, na, vb);
+        } else {
+            j = gallop_lower_u32(b, j + 1, nb, va);
+        }
+    }
+    return k;
+}
+
+/* Membership probe: for each candidate cand[i] present in the ascending
+ * run seg[0..ns), set hit[i] = 1 (hits accumulate across calls — the
+ * caller ORs one probe per fence-selected segment, then compresses).
+ * Returns the number of NEWLY set marks so the caller can stop probing
+ * further segments once every candidate is accounted for. */
+int64_t hostops_gallop_mark_u32(
+    int64_t nc, const uint32_t *cand, int64_t ns, const uint32_t *seg,
+    uint8_t *hit
+) {
+    int64_t j = 0, fresh = 0;
+    for (int64_t i = 0; i < nc; i++) {
+        if (hit[i]) continue;
+        uint32_t c = cand[i];
+        j = gallop_lower_u32(seg, j, ns, c);
+        if (j >= ns) break;
+        if (seg[j] == c) {
+            hit[i] = 1;
+            fresh++;
+        }
+    }
+    return fresh;
+}
+
 /* ------------------------------------------------- fast-path staging */
 
 /* One pass over raw 128-byte wire Transfer records doing everything the

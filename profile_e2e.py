@@ -554,6 +554,45 @@ def main(backend="numpy", batches=40, overlap=True, store_async=True,
         d2h = snap.get("device.d2h_bytes", {}).get("count", 0)
         print(f"  transfers: h2d {h2d / 1e6:.1f} MB, d2h {d2h / 1e6:.1f} MB")
 
+    # Multi-predicate query engine (docs/QUERY.md): a short post-window
+    # probe over the transfers just committed — plan/scan/probe/gather
+    # nest inside sm.query, so they are reported as their own table and
+    # NEVER added to the disjoint stage attribution above (the measured
+    # window contains no queries; these run after it, and the deltas
+    # below subtract everything before them).
+    sm = replica.state_machine
+    qf = np.zeros(1, dtype=types.QUERY_FILTER_V2_DTYPE)
+    rng_q = np.random.default_rng(11)
+    q0 = tracer.snapshot()
+    n_queries = 16
+    for _ in range(n_queries):
+        qf[0]["ledger"] = 1
+        qf[0]["code"] = 7
+        qf[0]["limit"] = BATCH
+        qf[0]["debit_account_id_lo"] = int(rng_q.integers(1, n_accounts + 1))
+        sm.query_transfers(qf[0])
+    q1 = tracer.snapshot()
+
+    def q_ms(key):
+        return (q1.get(key, {}).get("total_ms", 0.0)
+                - q0.get(key, {}).get("total_ms", 0.0))
+
+    if q_ms("sm.query"):
+        print("\nquery engine (post-window probe; plan/scan/probe/gather "
+              "nest inside sm.query — never part of the stage "
+              "attribution):")
+        print(f"  {'span':16s} {'ms/query':>9s}")
+        for stage, key in (
+            ("query.total", "sm.query"),
+            ("query.plan", "sm.query.plan"),
+            ("query.scan", "sm.query.scan"),
+            ("query.probe", "sm.query.probe"),
+            ("query.gather", "sm.query.gather"),
+        ):
+            ms = q_ms(key)
+            record[stage] = round(ms / n_queries, 3)
+            print(f"  {stage:16s} {ms / n_queries:9.3f}")
+
     trace_path = tracer.dump(
         os.environ.get("TIGERBEETLE_TPU_TRACE_FILE",
                        os.path.join(tmp, "trace_e2e.json"))

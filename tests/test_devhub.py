@@ -382,6 +382,56 @@ class TestBenchGateProfiles:
         assert gate.main(["--list"]) == 0
         out = capsys.readouterr().out
         assert f"profile={envprofile.legacy_profile_id()}" in out
+        # The query-engine keys are part of the gated surface.
+        assert "query_p50_ms" in out
+        assert "query_p99_ms" in out
+        assert "scan_rows_per_s" in out
+
+    QUERY = {
+        "query_p50_ms": 10.0,
+        "query_p99_ms": 40.0,
+        "scan_rows_per_s": 2.0e6,
+    }
+
+    def test_query_keys_gate(self, tmp_path, monkeypatch):
+        """query_p50/p99 (lower better) and scan_rows_per_s (higher
+        better) follow the 10% rule like every other gated key."""
+        base = json.loads(json.dumps(self.BASE))
+        base["query"] = dict(self.QUERY)
+        good = json.loads(json.dumps(base))
+        assert self._gate(tmp_path, monkeypatch,
+                          {"BENCH_r98.json": base}, {"extra": good}) == 0
+        slow = json.loads(json.dumps(base))
+        slow["query"]["query_p99_ms"] = 50.0  # +25% > 10% budget
+        assert self._gate(tmp_path, monkeypatch,
+                          {"BENCH_r98.json": base}, {"extra": slow}) == 1
+        starved = json.loads(json.dumps(base))
+        starved["query"]["scan_rows_per_s"] = 1.0e6  # -50%
+        assert self._gate(tmp_path, monkeypatch,
+                          {"BENCH_r98.json": base}, {"extra": starved}) == 1
+
+    def test_query_na_against_pre_query_baseline(self, tmp_path,
+                                                 monkeypatch, capsys):
+        """A pre-query-engine baseline has no query section: the three
+        keys report n/a, not MISSING-fail."""
+        cur = json.loads(json.dumps(self.BASE))
+        cur["query"] = dict(self.QUERY)
+        rc = self._gate(tmp_path, monkeypatch,
+                        {"BENCH_r98.json": self.BASE}, {"extra": cur})
+        assert rc == 0
+        assert "n/a" in capsys.readouterr().out
+
+    def test_query_missing_from_full_run_fails_closed(self, tmp_path,
+                                                      monkeypatch, capsys):
+        """Once a baseline carries the query section, a full (non
+        --sections) run that crashed before recording it is MISSING →
+        exit 1, never a silent pass."""
+        base = json.loads(json.dumps(self.BASE))
+        base["query"] = dict(self.QUERY)
+        rc = self._gate(tmp_path, monkeypatch,
+                        {"BENCH_r98.json": base}, {"extra": self.BASE})
+        assert rc == 1
+        assert "MISSING" in capsys.readouterr().out
 
     def test_corrupt_baseline_file_fails_loudly(self, tmp_path, monkeypatch,
                                                 capsys):

@@ -565,3 +565,56 @@ def test_loadgen_smoke_real_process():
         finally:
             proc.kill()
             proc.wait()
+
+
+def test_loadgen_mixed_read_write_real_process():
+    """Mixed read/write open-loop run at session scale: ≥500 sessions,
+    ≥20% of arrivals are multi-predicate QUERY_TRANSFERS (debit_account
+    ∧ ledger ∧ code, Zipf-hot accounts) sharing the same sessions and
+    arrival process as the writes. The run must hold every session
+    (sessions_failed == 0), answer queries, and every sampled concurrent
+    reply must be BYTE-IDENTICAL to a serial re-issue bounded at its own
+    cursor (loadgen.audit_queries — the mixed-run consistency bar)."""
+    from tigerbeetle_tpu.client import Client
+    from tigerbeetle_tpu.testing import loadgen
+
+    with tempfile.TemporaryDirectory(prefix="tbtpu-fd-mixed-") as tmp:
+        proc, port, mport, _path = loadgen.spawn_front_door(
+            tmp, config="development", backend="numpy", clients_max=1200,
+        )
+        try:
+            addrs = [("127.0.0.1", port)]
+            loadgen.create_accounts(addrs, 500)
+
+            # Preload: commit a few thousand Zipf-skewed transfers
+            # serially so hot-account queries return rows from the
+            # run's first arrival (the byte-identity audit skips empty
+            # replies — they carry no bounding cursor).
+            pre = loadgen._BatchFactory(500, 512, 1.1, seed=0x77)
+            client = Client(addrs)
+            for _ in range(4):
+                _first, _n, body = pre.make()
+                ev = np.frombuffer(bytearray(body), dtype=types.TRANSFER_DTYPE)
+                assert len(client.create_transfers(ev)) == 0
+            client.close()
+
+            lg = loadgen.LoadGen(
+                addrs, sessions=500, accounts=500, batch=64,
+                offered_rate=3000.0, duration_s=2.5,
+                ramp_s=2.0, seed=0x53, first_id=pre.next_id,
+                read_fraction=0.25, query_limit=64,
+            )
+            res = asyncio.run(lg.run())
+            assert res["sessions_failed"] == 0, res
+            assert res["accepted_tx"] > 0
+            assert res["queries_offered"] > 0
+            assert res["queries_ok"] > 0, res
+            assert res["query_perceived_p50_ms"] > 0
+            aud = loadgen.audit(addrs, lg.stats.acked_sample, mport)
+            assert aud["ok"] == 1, f"audit failed: {aud}"
+            qaud = loadgen.audit_queries(addrs, lg.stats.query_sample)
+            assert qaud["queries_checked"] > 0, qaud
+            assert qaud["ok"] == 1, f"query audit failed: {qaud}"
+        finally:
+            proc.kill()
+            proc.wait()

@@ -353,8 +353,18 @@ class Client:
         user_data_128: int, user_data_64: int, user_data_32: int,
         ledger: int, code: int, timestamp_min: int, timestamp_max: int,
         limit: int, flags: int,
+        debit_account_id: int = 0, credit_account_id: int = 0,
     ) -> bytes:
-        f = np.zeros(1, dtype=types.QUERY_FILTER_DTYPE)
+        # v2 (account-id predicates) only when one is actually set: the
+        # replica discriminates filter version by body SIZE, and v1 bytes
+        # are a strict prefix of v2 — old servers keep working as long as
+        # the new predicates stay unused.
+        v2 = bool(debit_account_id or credit_account_id)
+        f = np.zeros(
+            1,
+            dtype=types.QUERY_FILTER_V2_DTYPE if v2
+            else types.QUERY_FILTER_DTYPE,
+        )
         f[0]["user_data_128_lo"] = user_data_128 & types.U64_MAX
         f[0]["user_data_128_hi"] = user_data_128 >> 64
         f[0]["user_data_64"] = user_data_64
@@ -365,6 +375,11 @@ class Client:
         f[0]["timestamp_max"] = timestamp_max
         f[0]["limit"] = limit
         f[0]["flags"] = flags
+        if v2:
+            f[0]["debit_account_id_lo"] = debit_account_id & types.U64_MAX
+            f[0]["debit_account_id_hi"] = debit_account_id >> 64
+            f[0]["credit_account_id_lo"] = credit_account_id & types.U64_MAX
+            f[0]["credit_account_id_hi"] = credit_account_id >> 64
         return f.tobytes()
 
     def query_accounts(
@@ -386,12 +401,47 @@ class Client:
         user_data_32: int = 0, ledger: int = 0, code: int = 0,
         timestamp_min: int = 0, timestamp_max: int = 0,
         limit: int = 8190, flags: int = 0,
+        debit_account_id: int = 0, credit_account_id: int = 0,
     ) -> np.ndarray:
+        """Multi-predicate equality query over transfers: zero fields are
+        ignored, nonzero fields ANDed; flags bit 0 = reversed. The
+        account-id predicates ride the v2 filter shape (docs/QUERY.md)."""
         reply = self._roundtrip(Operation.QUERY_TRANSFERS, self._query_body(
             user_data_128, user_data_64, user_data_32, ledger, code,
             timestamp_min, timestamp_max, limit, flags,
+            debit_account_id, credit_account_id,
         ))
         return np.frombuffer(bytearray(reply.body), dtype=types.TRANSFER_DTYPE)
+
+    def query_transfers_paged(
+        self, page_limit: int = 1024, flags: int = 0, timestamp_min: int = 0,
+        timestamp_max: int = 0, **predicates,
+    ):
+        """Generator over query_transfers pages with STABLE timestamp
+        cursors (the get_account_history paging idiom): each page's last
+        row's timestamp advances the window — timestamps are unique and
+        monotone with commit order, so pages never overlap, never skip,
+        and stay stable across concurrent ingest on the already-covered
+        side (docs/QUERY.md cursor contract). Yields one ndarray per
+        page until a short page ends the scan."""
+        reversed_ = bool(flags & 1)
+        ts_min, ts_max = timestamp_min, timestamp_max
+        while True:
+            page = self.query_transfers(
+                timestamp_min=ts_min, timestamp_max=ts_max,
+                limit=page_limit, flags=flags, **predicates,
+            )
+            if len(page):
+                yield page
+            if len(page) < page_limit:
+                return
+            cursor = int(page["timestamp"][-1])
+            if reversed_:
+                ts_max = cursor - 1
+                if ts_max < 1:
+                    return
+            else:
+                ts_min = cursor + 1
 
 
 class AsyncClient:

@@ -345,6 +345,12 @@ class Grid:
     def drop_cache(self) -> None:
         self._cache.clear()
 
+    def cache_contains(self, index: int) -> bool:
+        """True when the block's payload is LRU-resident — a read would
+        be RAM-speed rather than a storage read + checksum verify. Cost
+        signal only (the scan planner's fetch costing); never correctness."""
+        return index in self._cache
+
 
 class MemGrid(Grid):
     """Grid over a lazy in-memory page map (no Zone needed) — the default

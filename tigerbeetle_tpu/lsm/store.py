@@ -208,6 +208,66 @@ def merge_host_kway_bloom(parts_k, parts_v, seg_ends, seg_blooms):
     return _merge_c(lib, parts, seg_ends, seg_blooms)
 
 
+def intersect_sorted_u32(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Unique common values of two ascending u32 arrays — the scan
+    engine's pairwise AND (scan_merge.zig:252 intersection). The C path
+    gallops on whichever side is ahead, so a short candidate list probes
+    a long run in O(short * log(gap)); numpy intersect1d fallback is
+    value-identical (both emit the unique intersection, ascending)."""
+    na, nb = len(a), len(b)
+    if na == 0 or nb == 0:
+        return np.zeros(0, dtype=np.uint32)
+    lib = _hostops()
+    if (
+        lib is not None and min(na, nb) > 32
+        and hasattr(lib, "hostops_intersect_u32")
+    ):
+        import ctypes
+
+        a_c = np.ascontiguousarray(a, dtype=np.uint32)
+        b_c = np.ascontiguousarray(b, dtype=np.uint32)
+        out = np.empty(min(na, nb), dtype=np.uint32)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        k = lib.hostops_intersect_u32(
+            na, a_c.ctypes.data_as(u32p), nb, b_c.ctypes.data_as(u32p),
+            out.ctypes.data_as(u32p),
+        )
+        return out[:k]
+    return np.intersect1d(
+        np.asarray(a, dtype=np.uint32), np.asarray(b, dtype=np.uint32)
+    ).astype(np.uint32, copy=False)
+
+
+def gallop_mark_u32(cand: np.ndarray, seg: np.ndarray,
+                    hit: np.ndarray) -> int:
+    """Mark (hit[i] = True) every ascending candidate row present in the
+    ascending run segment; marks accumulate across calls so one probe per
+    fence-selected segment ORs into a shared mask. Returns the number of
+    NEWLY marked candidates (callers stop probing once all are marked).
+    Numpy fallback is mark-identical (membership is membership)."""
+    nc, ns = len(cand), len(seg)
+    if nc == 0 or ns == 0:
+        return 0
+    lib = _hostops()
+    if lib is not None and ns > 64 and hasattr(lib, "hostops_gallop_mark_u32"):
+        import ctypes
+
+        cand_c = np.ascontiguousarray(cand, dtype=np.uint32)
+        seg_c = np.ascontiguousarray(seg, dtype=np.uint32)
+        assert hit.dtype == np.uint8 and hit.flags["C_CONTIGUOUS"]
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        return int(lib.hostops_gallop_mark_u32(
+            nc, cand_c.ctypes.data_as(u32p), ns, seg_c.ctypes.data_as(u32p),
+            hit.ctypes.data_as(u8p),
+        ))
+    fresh = ~hit.view(bool) & np.isin(
+        np.asarray(cand, dtype=np.uint32), np.asarray(seg, dtype=np.uint32)
+    )
+    hit[fresh] = 1
+    return int(fresh.sum())
+
+
 def sort_lo_major(keys: np.ndarray) -> np.ndarray:
     """Stable argsort by the lo column (ties keep insertion order)."""
     lib = _hostops()

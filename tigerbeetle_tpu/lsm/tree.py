@@ -48,6 +48,33 @@ from tigerbeetle_tpu.lsm.store import (
 ENTRY_SIZE = KEY_DTYPE.itemsize + 4  # key + u32 value
 U64_MAX = (1 << 64) - 1
 
+
+def _mark_seg(cand: np.ndarray, seg: np.ndarray, hit: np.ndarray) -> int:
+    """Mark hit[i] = 1 for every ascending cand[i] present in seg;
+    returns the newly marked count (marks accumulate across segments).
+    Ascending segments — the flush-fresh common case, commit order IS
+    row order — take the C gallop. Segments a merge left non-ascending
+    (tables are LO-major only; account_rows also interleaves
+    debit-then-credit runs per commit) are marked with one vectorized
+    searchsorted into cand instead of paying a per-segment sort."""
+    from tigerbeetle_tpu.lsm.store import gallop_mark_u32
+
+    if len(cand) == 0 or len(seg) == 0:
+        return 0
+    if len(seg) == 1 or bool(np.all(seg[1:] >= seg[:-1])):
+        return gallop_mark_u32(cand, seg, hit)
+    pos = np.searchsorted(cand, seg)
+    # A position of len(cand) means seg value > every candidate; clamp
+    # to 0, which the equality re-check below rejects.
+    pos[pos == len(cand)] = 0
+    sel = cand[pos] == seg
+    if not sel.any():
+        return 0
+    idx = pos[sel]
+    before = int(np.count_nonzero(hit))
+    hit[idx] = 1
+    return int(np.count_nonzero(hit)) - before
+
 # Per-data-block fence in the index block.
 INDEX_ENTRY_DTYPE = np.dtype(
     [
@@ -210,7 +237,14 @@ class _MergeStream:
             self.keys = np.zeros(0, dtype=KEY_DTYPE)
             self.vals = np.zeros(0, dtype=np.uint32)
             return k, v
-        cut = int(np.searchsorted(self.keys["lo"], upto_key, side="right"))
+        # np.uint64 needle, NOT a python int: numpy promotes uint64 vs
+        # int to float64, whose 53-bit mantissa collapses composite keys
+        # (tag byte => every key >= 2^56) that differ only in low bits —
+        # the cut then overshoots the bound and the merge emits an
+        # out-of-order chunk (disordered table tails at bench scale).
+        cut = int(np.searchsorted(
+            self.keys["lo"], np.uint64(upto_key), side="right"
+        ))
         k, v = self.keys[:cut], self.vals[:cut]
         self.keys, self.vals = self.keys[cut:], self.vals[cut:]
         return k, v
@@ -1152,12 +1186,21 @@ class DurableIndex:
                 s = np.searchsorted(bk["lo"], k_lo, side="left")
                 e = np.searchsorted(bk["lo"], k_lo, side="right")
                 if e > s:
+                    # Tables are LO-major ordered only: a merge drains
+                    # equal-lo ties oldest-stream-first with within-run
+                    # order preserved (_merge_tables), so hi need NOT
+                    # ascend inside the segment — window by mask, never
+                    # searchsorted.
                     run_hi = bk["hi"][s:e]
-                    hs = np.searchsorted(run_hi, np.uint64(hi_min), side="left")
-                    he = np.searchsorted(run_hi, np.uint64(hi_max), side="right")
-                    if he > hs:
-                        parts.append(bv[s + hs : s + he])
-                        total += he - hs
+                    sel = (run_hi >= np.uint64(hi_min)) & (
+                        run_hi <= np.uint64(hi_max)
+                    )
+                    n_sel = int(np.count_nonzero(sel))
+                    if n_sel:
+                        parts.append(
+                            bv[s:e] if n_sel == e - s else bv[s:e][sel]
+                        )
+                        total += n_sel
                         if total > cap:
                             return np.concatenate(parts), False
         self._sort_mem_lazily()
@@ -1186,6 +1229,187 @@ class DurableIndex:
         vals, complete = self.scan_lo_capped(k_lo, hi_min, hi_max, cap=1 << 62)
         assert complete
         return vals
+
+    # --- multi-predicate scan engine support ---------------------------
+    #
+    # The ScanBuilder planner (lsm/scan.py) needs two primitives beyond
+    # the materializing scans above: a zero-IO cardinality ESTIMATE (to
+    # order predicates by selectivity, reference scan_builder.zig) and a
+    # candidate PROBE (gallop the driver predicate's sorted row list
+    # through this index's fence-selected segments instead of
+    # materializing the whole scan — scan_merge.zig's probe side).
+
+    def scan_estimate(self, k_lo: int) -> int:
+        """Fence-only upper bound on a key.lo prefix scan's row count:
+        the summed entry count of every fence-selected candidate block,
+        plus this tree's resident memtable rows (identical for every
+        predicate of a query, so it never perturbs the ranking). Zero
+        block reads — monotone enough in the true scan size to ORDER
+        predicates by, which is all the planner needs."""
+        k_lo = np.uint64(k_lo)
+        est = 0
+        for table in self._tables_newest_first():
+            fences = self._table_fences(table)
+            b_lo = int(np.searchsorted(fences["last_lo"], k_lo, side="left"))
+            b_hi = min(
+                int(np.searchsorted(fences["first_lo"], k_lo, side="right")),
+                len(fences),
+            )
+            if b_hi > b_lo:
+                est += int(fences["count"][b_lo:b_hi].sum())
+        return est
+
+    def scan_probe_lo(
+        self, k_lo: int, cand: np.ndarray, hit: np.ndarray,
+        hi_min: int = 0, hi_max: int = U64_MAX,
+    ) -> int:
+        """Mark (hit[i] = 1) every ascending candidate row that this
+        index holds under key.lo == k_lo with key.hi ∈ [hi_min, hi_max].
+        Fence-pruned block walk + per-segment membership probe
+        (_mark_seg: C gallop on ascending segments, one vectorized
+        searchsorted otherwise) — the run is never materialized, so an
+        UNSELECTIVE predicate costs O(|cand| · log gap) per touched
+        segment instead of a full scan + sort. Tables are LO-major
+        ordered only (equal-lo merge ties drain oldest-stream-first,
+        within-run order preserved — _merge_tables), so the hi window is
+        a MASK and the segment's values need not ascend (flush-fresh
+        segments do: commit order IS row order). Returns newly marked
+        count; counts pruned/probed runs on lsm.scan.* (satellite:
+        Bloom/fence prune-rate observability)."""
+        k_lo = np.uint64(k_lo)
+        marked = 0
+        probed = pruned = 0
+        for table in self._tables_newest_first():
+            if marked >= len(cand):
+                break
+            fences = self._table_fences(table)
+            b_lo = int(np.searchsorted(fences["last_lo"], k_lo, side="left"))
+            b_hi = min(
+                int(np.searchsorted(fences["first_lo"], k_lo, side="right")),
+                len(fences),
+            )
+            if b_hi <= b_lo:
+                pruned += 1
+                continue
+            probed += 1
+            for b in range(b_lo, b_hi):
+                bk, bv = self._read_data_block(
+                    int(fences[b]["block"]), int(fences[b]["count"])
+                )
+                s = np.searchsorted(bk["lo"], k_lo, side="left")
+                e = np.searchsorted(bk["lo"], k_lo, side="right")
+                if e > s:
+                    run_hi = bk["hi"][s:e]
+                    sel = (run_hi >= np.uint64(hi_min)) & (
+                        run_hi <= np.uint64(hi_max)
+                    )
+                    if sel.any():
+                        marked += _mark_seg(cand, bv[s:e][sel], hit)
+        self._sort_mem_lazily()
+        for mem_keys, mem_vals in self._mem:
+            if marked >= len(cand):
+                break
+            sel = (
+                (mem_keys["lo"] == k_lo)
+                & (mem_keys["hi"] >= np.uint64(hi_min))
+                & (mem_keys["hi"] <= np.uint64(hi_max))
+            )
+            if sel.any():
+                marked += _mark_seg(cand, mem_vals[sel], hit)
+        if tracer.enabled():
+            tracer.count("lsm.scan.runs_probed", probed)
+            tracer.count("lsm.scan.runs_pruned", pruned)
+        return marked
+
+    def range_estimate(self, key: np.void) -> int:
+        """scan_estimate for an exact (lo, hi) key over a non-unique
+        index (the account_rows probe side): fence window narrowed like
+        lookup_range, with per-run Blooms — where one is already built —
+        pruning whole tables for free (no false negatives, full-key
+        probe). Zero block reads either way."""
+        assert not self.unique
+        k_lo, k_hi = key["lo"], key["hi"]
+        est = 0
+        for table in self._tables_newest_first():
+            bloom = table.bloom
+            if bloom is not None and not bool(
+                bloom.maybe(
+                    np.asarray([k_lo], dtype=np.uint64),
+                    np.asarray([k_hi], dtype=np.uint64),
+                )[0]
+            ):
+                continue
+            fences = self._table_fences(table)
+            b_lo = int(np.searchsorted(fences["last_lo"], k_lo, side="left"))
+            b_hi = min(
+                int(np.searchsorted(fences["first_lo"], k_lo, side="right")),
+                len(fences),
+            )
+            if b_hi > b_lo:
+                est += int(fences["count"][b_lo:b_hi].sum())
+        return est
+
+    def range_probe(
+        self, key: np.void, cand: np.ndarray, hit: np.ndarray
+    ) -> int:
+        """scan_probe_lo for an exact (lo, hi) key (lookup_range's probe
+        twin): per-run Blooms gate the block walk — a bloom-negative
+        table is skipped without IO and counted as pruned. Blooms build
+        lazily on first probe exactly like lookup_batch (with the
+        decoded mirror, or one streaming pass over-budget), so repeated
+        hot-account probes stop paying for cold runs. Segment values
+        need not ascend (account_rows interleaves debit-then-credit row
+        runs per commit and merges only keep lo order) — _mark_seg
+        gallops ascending segments and searchsorted-marks the rest."""
+        assert not self.unique
+        self._resolve_mem()
+        k_lo, k_hi = key["lo"], key["hi"]
+        lo1 = np.asarray([k_lo], dtype=np.uint64)
+        hi1 = np.asarray([k_hi], dtype=np.uint64)
+        marked = 0
+        probed = pruned = 0
+        for table in self._tables_newest_first():
+            if marked >= len(cand):
+                break
+            bloom = table.bloom
+            if bloom is None and table.count >= self.DECODE_MIN_ROWS:
+                if self._decode_table(table) is None and table.bloom is None:
+                    bloom = self._stream_bloom(table)
+                else:
+                    bloom = table.bloom
+            if bloom is not None and not bool(bloom.maybe(lo1, hi1)[0]):
+                pruned += 1
+                continue
+            fences = self._table_fences(table)
+            b_lo = int(np.searchsorted(fences["last_lo"], k_lo, side="left"))
+            b_hi = min(
+                int(np.searchsorted(fences["first_lo"], k_lo, side="right")),
+                len(fences),
+            )
+            if b_hi <= b_lo:
+                pruned += 1
+                continue
+            probed += 1
+            for b in range(b_lo, b_hi):
+                bk, bv = self._read_data_block(
+                    int(fences[b]["block"]), int(fences[b]["count"])
+                )
+                s = np.searchsorted(bk["lo"], k_lo, side="left")
+                e = np.searchsorted(bk["lo"], k_lo, side="right")
+                if e > s:
+                    sel = bk["hi"][s:e] == k_hi
+                    if sel.any():
+                        marked += _mark_seg(cand, bv[s:e][sel], hit)
+        for mem_keys, mem_vals in self._mem:
+            if marked >= len(cand):
+                break
+            sel = (mem_keys["lo"] == k_lo) & (mem_keys["hi"] == k_hi)
+            if sel.any():
+                marked += _mark_seg(cand, mem_vals[sel], hit)
+        if tracer.enabled():
+            tracer.count("lsm.scan.runs_probed", probed)
+            tracer.count("lsm.scan.runs_pruned", pruned)
+        return marked
 
     # --- checkpoint -----------------------------------------------------
 

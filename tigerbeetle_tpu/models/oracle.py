@@ -737,6 +737,7 @@ class Oracle:
         user_data_32: int = 0, ledger: int = 0, code: int = 0,
         timestamp_min: int = 0, timestamp_max: int = 0,
         limit: int = 8190, flags: int = 0,
+        debit_account_id: int = 0, credit_account_id: int = 0,
     ) -> List[Transfer]:
         if not self._query_filter_valid(timestamp_min, timestamp_max, limit, flags):
             return []
@@ -750,6 +751,9 @@ class Oracle:
             and (not user_data_32 or t.user_data_32 == user_data_32)
             and (not ledger or t.ledger == ledger)
             and (not code or t.code == code)
+            and (not debit_account_id or t.debit_account_id == debit_account_id)
+            and (not credit_account_id
+                 or t.credit_account_id == credit_account_id)
         ]
         matches.sort(key=lambda t: t.timestamp, reverse=bool(flags & 1))
         return [t.copy() for t in matches[:limit]]

@@ -189,7 +189,9 @@ class StateMachine:
         # over their data file's grid zone; standalone use gets a lazy
         # in-memory grid with the same code path.
         self.grid = grid if grid is not None else MemGrid(
-            config.grid_block_count, config.lsm_block_size
+            config.grid_block_count,
+            config.lsm_block_size,
+            config.grid_cache_blocks,
         )
         a = config.accounts_max
 
@@ -2106,15 +2108,25 @@ class StateMachine:
         return out
 
     def query_transfers(self, f: np.void) -> np.ndarray:
-        """Index-backed equality query over transfers (reference ScanBuilder
-        range scans per index + boolean merge, scan_builder.zig:454,
-        scan_merge.zig:252): each nonzero filter field becomes a
-        composite-key prefix scan over the combined query index, the row
-        sets intersect vectorized, and the gathered rows are re-verified
-        exactly (fold56 collisions over-select, never mis-answer)."""
+        """Multi-predicate equality query over transfers via the scan
+        engine (reference ScanBuilder range scans per index + boolean
+        merge, scan_builder.zig:454, scan_merge.zig:252): nonzero filter
+        fields become predicates over the combined query index (field
+        tags) and the exact-key account index (v2 debit/credit
+        predicates), the planner orders them by fence-estimated
+        cardinality, the cheapest drives a galloping probe of the rest
+        (lsm/scan.ScanBuilder), and the gathered rows are re-verified
+        exactly (fold56 collisions and account side-blindness
+        over-select, never mis-answer). The sm.query.* spans feed the
+        gated query_p50_ms/query_p99_ms lifecycle keys."""
         from tigerbeetle_tpu.lsm import scan
 
+        with tracer.span("sm.query"):
+            return self._query_transfers_inner(f, scan)
+
+    def _query_transfers_inner(self, f: np.void, scan) -> np.ndarray:
         self.store_barrier()
+        names = f.dtype.names
         ud128_lo = int(f["user_data_128_lo"])
         ud128_hi = int(f["user_data_128_hi"])
         ud64 = int(f["user_data_64"])
@@ -2123,23 +2135,40 @@ class StateMachine:
         code = int(f["code"])
         limit = int(f["limit"])
         flags = int(f["flags"])
+        # v2 filter shape (size-discriminated at decode): account-id
+        # equality predicates, absent fields read as 0 (= unset).
+        dr_lo = int(f["debit_account_id_lo"]) if "debit_account_id_lo" in names else 0
+        dr_hi = int(f["debit_account_id_hi"]) if "debit_account_id_hi" in names else 0
+        cr_lo = int(f["credit_account_id_lo"]) if "credit_account_id_lo" in names else 0
+        cr_hi = int(f["credit_account_id_hi"]) if "credit_account_id_hi" in names else 0
         ts_min_raw, ts_max_raw = int(f["timestamp_min"]), int(f["timestamp_max"])
         if not Oracle._query_filter_valid(ts_min_raw, ts_max_raw, limit, flags):
             return np.zeros(0, dtype=types.TRANSFER_DTYPE)
         ts_min = ts_min_raw if ts_min_raw else 1
         ts_max = ts_max_raw if ts_max_raw else U64_MAX - 1
 
-        preds = []
+        builder = scan.ScanBuilder(
+            self.query_rows, self.account_rows, ts_min, ts_max,
+            log_stats=(
+                self.transfer_log.count,
+                len(self.transfer_log.blocks),
+                self.transfer_log.resident_fraction(),
+            ),
+        )
         if ud128_lo or ud128_hi:
-            preds.append((scan.TAG_UD128, ud128_lo, ud128_hi))
+            builder.where_field(scan.TAG_UD128, ud128_lo, ud128_hi)
         if ud64:
-            preds.append((scan.TAG_UD64, ud64, 0))
+            builder.where_field(scan.TAG_UD64, ud64)
         if ud32:
-            preds.append((scan.TAG_UD32, ud32, 0))
+            builder.where_field(scan.TAG_UD32, ud32)
         if ledger:
-            preds.append((scan.TAG_LEDGER, ledger, 0))
+            builder.where_field(scan.TAG_LEDGER, ledger)
         if code:
-            preds.append((scan.TAG_CODE, code, 0))
+            builder.where_field(scan.TAG_CODE, code)
+        if dr_lo or dr_hi:
+            builder.where_account(dr_lo, dr_hi)
+        if cr_lo or cr_hi:
+            builder.where_account(cr_lo, cr_hi)
 
         def verify(t: np.ndarray) -> np.ndarray:
             keep = (t["timestamp"] >= np.uint64(ts_min)) & (
@@ -2157,9 +2186,17 @@ class StateMachine:
                 keep &= t["ledger"] == np.uint32(ledger)
             if code:
                 keep &= t["code"] == np.uint16(code)
+            if dr_lo or dr_hi:
+                keep &= (t["debit_account_id_lo"] == np.uint64(dr_lo)) & (
+                    t["debit_account_id_hi"] == np.uint64(dr_hi)
+                )
+            if cr_lo or cr_hi:
+                keep &= (t["credit_account_id_lo"] == np.uint64(cr_lo)) & (
+                    t["credit_account_id_hi"] == np.uint64(cr_hi)
+                )
             return keep
 
-        if not preds:
+        if not builder._preds:
             # No equality predicate: bounded walk of the timestamp-ordered
             # object log (newest-first under REVERSED), stopping at limit.
             t = self._log_window(ts_min, ts_max, limit, bool(flags & 1))
@@ -2168,30 +2205,27 @@ class StateMachine:
                 ix = ix[::-1]
             return t[ix[:limit]]
 
-        # Adaptive selectivity: abandon scans past the cap (their
-        # predicate is re-verified on the gathered rows instead, which is
-        # cheaper than materializing an unselective scan in full).
-        complete = []
-        scanned = []
-        for tag, lo, hi in preds:
-            vals, full = self.query_rows.scan_lo_capped(
-                scan.prefix(tag, lo, hi), ts_min, ts_max
+        # The engine: fence-estimated plan, driver scan, galloping
+        # probes. `rows` is an ascending candidate SUPERSET; the chunked
+        # gather below re-verifies every predicate exactly.
+        with tracer.span("sm.query.plan"):
+            plan = builder.plan()
+        with tracer.span("sm.query.scan"):
+            cand = np.ascontiguousarray(
+                builder._materialize(plan[0]), dtype=np.uint32
             )
-            scanned.append((vals, full))
-            if full:
-                complete.append(vals)
-        if complete:
-            rows = scan.intersect_rows(complete)
-        else:
-            # Every predicate is unselective: fall back to the full scan
-            # of the one that accumulated the least before hitting the
-            # cap (best available signal).
-            tag, lo, hi = preds[
-                min(range(len(preds)), key=lambda i: len(scanned[i][0]))
-            ]
-            rows = self.query_rows.scan_lo(
-                scan.prefix(tag, lo, hi), ts_min, ts_max
-            )
+        with tracer.span("sm.query.probe"):
+            # Probes exist only to shrink the gather: each runs while
+            # its index walk costs less than the block reads + row
+            # copies it saves (builder._probe_pays, buffer-aware), and
+            # verify() re-checks every predicate exactly either way.
+            for p in plan[1:]:
+                if not builder._probe_pays(p, len(cand)):
+                    break
+                hit = np.zeros(len(cand), dtype=np.uint8)
+                builder._probe(p, cand, hit)
+                cand = cand[hit.view(bool)]
+        rows = cand
 
         # Limit-aware chunked gather: candidates are timestamp-ordered, so
         # walk them from the answering end in chunks, verify, and stop as
@@ -2203,19 +2237,20 @@ class StateMachine:
         parts: list = []
         got = 0
         pos = len(rows) if reversed_ else 0
-        while got < limit and (pos > 0 if reversed_ else pos < len(rows)):
-            if reversed_:
-                lo_ix = max(0, pos - chunk)
-                sel_rows = rows[lo_ix:pos]
-                pos = lo_ix
-            else:
-                sel_rows = rows[pos : pos + chunk]
-                pos += chunk
-            t = self.transfer_log.gather(sel_rows)
-            hit = t[verify(t)]
-            if len(hit):
-                parts.append(hit)
-                got += len(hit)
+        with tracer.span("sm.query.gather"):
+            while got < limit and (pos > 0 if reversed_ else pos < len(rows)):
+                if reversed_:
+                    lo_ix = max(0, pos - chunk)
+                    sel_rows = rows[lo_ix:pos]
+                    pos = lo_ix
+                else:
+                    sel_rows = rows[pos : pos + chunk]
+                    pos += chunk
+                t = self.transfer_log.gather(sel_rows)
+                hit = t[verify(t)]
+                if len(hit):
+                    parts.append(hit)
+                    got += len(hit)
         if not parts:
             return np.zeros(0, dtype=types.TRANSFER_DTYPE)
         if reversed_:

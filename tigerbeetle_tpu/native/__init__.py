@@ -153,6 +153,18 @@ def hostops() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_void_p), u64p,
         ]
         lib.hostops_merge_kv_bloom.restype = ctypes.c_int
+    # Galloping sorted-set row intersects (round-21 multi-predicate scan
+    # engine). Same stale-.so guard: older libraries keep the numpy path.
+    if hasattr(lib, "hostops_intersect_u32"):
+        lib.hostops_intersect_u32.argtypes = [
+            ctypes.c_int64, u32p, ctypes.c_int64, u32p, u32p,
+        ]
+        lib.hostops_intersect_u32.restype = ctypes.c_int64
+    if hasattr(lib, "hostops_gallop_mark_u32"):
+        lib.hostops_gallop_mark_u32.argtypes = [
+            ctypes.c_int64, u32p, ctypes.c_int64, u32p, u8p,
+        ]
+        lib.hostops_gallop_mark_u32.restype = ctypes.c_int64
     # The C staging ladder hardcodes the wire-contract result codes; refuse
     # the shim (fall back to numpy) if the enums ever drift.
     from tigerbeetle_tpu.results import CreateTransferResult as _TR

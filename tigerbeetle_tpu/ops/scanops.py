@@ -1,0 +1,98 @@
+"""Device-resident sorted-set intersect for the multi-predicate scan
+engine (lsm/scan.ScanBuilder's AND-merge, reference scan_merge.zig:252).
+
+The host engine gallops one sorted row list through another in C
+(csrc/hostops.c hostops_intersect_u32). Where candidate row sets already
+live on the device — the round-13 lazy-run tier keeps query-index runs
+device-resident until a flush or barrier demands bytes — the AND-merge
+can run there instead: a dense vectorized `searchsorted` membership test
+(one fused kernel, sequential reads per probe, no comparator-driven XLA
+sort involved), the formulation that suits an accelerator's VPU rather
+than the pointer-chasing merge loop.
+
+Dispatch is SPLIT-PHASE like every other kernel in ops/: the jit call
+stages + dispatches and returns device arrays; the single device→host
+sync (`finish_intersect`, the jaxlint-sanctioned seam) happens when the
+query path — never the commit path — compresses the mask. Routing
+follows ops/merge.device_merge_pays (off on XLA-CPU, where the host C
+gallop wins; TIGERBEETLE_TPU_DEVICE_MERGE forces either way), and both
+routes are value-identical: tests/test_query.py's determinism guard
+byte-compares result rows across forced routes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tigerbeetle_tpu import tracer
+from tigerbeetle_tpu.ops.merge import bucket_pow2
+
+# Row-id pad sentinel: object-log rows are u32 row indices and
+# 0xFFFFFFFF is lsm.store.NOT_FOUND — never a real row, so pads sort
+# strictly last and can never collide with a candidate.
+_PAD = np.uint32(0xFFFFFFFF)
+
+
+@jax.jit
+def scan_intersect_mask(cand, run):
+    """Membership mask of ascending u32 `cand` in ascending u32 `run`:
+    mask[i] = cand[i] ∈ run. One vectorized binary search per candidate
+    (dense, gather-light) — the device analog of the C gallop's probe
+    side. Pads (0xFFFFFFFF) in `cand` match pads in `run`; callers strip
+    by length, so the tail never leaks into a result."""
+    ix = jnp.searchsorted(run, cand, side="left")
+    ixc = jnp.minimum(ix, run.shape[0] - 1)
+    return run[ixc] == cand
+
+
+def device_scan_pays() -> bool:
+    """Whether the device intersect route pays on this backend — ONE
+    policy with the rest of the device query pipeline
+    (ops/merge.device_merge_pays: accelerator backends only,
+    TIGERBEETLE_TPU_DEVICE_MERGE overrides)."""
+    from tigerbeetle_tpu.ops.merge import device_merge_pays
+
+    return device_merge_pays()
+
+
+def _pad_sorted_u32(a: np.ndarray) -> np.ndarray:
+    """Bucket-pad an ascending u32 array with trailing 0xFFFFFFFF
+    sentinels (pow-2 buckets ≥ MERGE_TILE, merge.bucket_pow2 — one
+    compile per bucket)."""
+    n = len(a)
+    out = np.full(bucket_pow2(n), _PAD, dtype=np.uint32)
+    out[:n] = a
+    return out
+
+
+def intersect_sorted_device(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Unique common values of two ascending unique u32 arrays via the
+    device membership kernel — value-identical to the host
+    store.intersect_sorted_u32 (both emit the ascending unique
+    intersection; inputs here are scan row lists, unique by
+    construction). Stages, dispatches, and finishes in one call: the
+    query path is allowed its read-side sync (the same contract as
+    store_barrier), the commit path never calls this."""
+    na, nb = len(a), len(b)
+    if na == 0 or nb == 0:
+        return np.zeros(0, dtype=np.uint32)
+    cand, run = (a, b) if na <= nb else (b, a)
+    cand_p = _pad_sorted_u32(np.ascontiguousarray(cand, dtype=np.uint32))
+    run_p = _pad_sorted_u32(np.ascontiguousarray(run, dtype=np.uint32))
+    t_disp = tracer.device_dispatch(
+        "scan_intersect_mask", h2d_bytes=cand_p.nbytes + run_p.nbytes
+    )
+    mask_dev = scan_intersect_mask(cand_p, run_p)
+    return finish_intersect(mask_dev, cand, t_disp)
+
+
+def finish_intersect(mask_dev, cand: np.ndarray, t_disp: int) -> np.ndarray:
+    """The device→host sync of the intersect (jaxlint-sanctioned seam):
+    pull the membership mask, compress the candidate list."""
+    mask = np.asarray(mask_dev)
+    tracer.device_finish(
+        "scan_intersect_mask", t_disp, d2h_bytes=mask.nbytes
+    )
+    return np.asarray(cand, dtype=np.uint32)[mask[: len(cand)]]

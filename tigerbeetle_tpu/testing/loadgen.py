@@ -118,6 +118,20 @@ class _BatchFactory:
         ev["code"] = 7
         return first, n, ev.tobytes()
 
+    def make_query(self, limit: int = 256) -> bytes:
+        """One QUERY_TRANSFERS filter body: a 3-predicate intersect
+        (debit_account ∧ ledger ∧ code) over a Zipf-hot account — the
+        same skew the write side uses, so hot accounts are queried hot.
+        Always ascending (flags=0): the post-run serial-oracle audit
+        bounds the recorded page by its last timestamp, which needs the
+        page to be the FIRST `n` matches in commit order."""
+        from tigerbeetle_tpu.client import Client
+
+        acct = int(self._draw(1)[0])
+        return Client._query_body(
+            0, 0, 0, 1, 7, 0, 0, limit, 0, debit_account_id=acct,
+        )
+
 
 class _Evicted(Exception):
     pass
@@ -152,11 +166,23 @@ class _Stats:
     blackouts: List[float] = field(default_factory=list)
     # Sample of acked transfer ids for the post-run durability audit.
     acked_sample: List[int] = field(default_factory=list)
+    # Mixed-run read side: query arrivals offered/answered, perceived
+    # latencies (kept out of the write-side `perceived` list so the
+    # write bars stay comparable across read fractions), and a bounded
+    # (filter body, reply body) sample for the serial-oracle audit.
+    queries_offered: int = 0
+    queries_ok: int = 0
+    query_perceived: List[float] = field(default_factory=list)
+    query_sample: List[Tuple[bytes, bytes]] = field(default_factory=list)
 
     def record_acked(self, first_id: int, n: int) -> None:
         if len(self.acked_sample) < 256:
             self.acked_sample.append(first_id)
             self.acked_sample.append(first_id + n - 1)
+
+    def record_query(self, body: bytes, reply: bytes) -> None:
+        if len(self.query_sample) < 64:
+            self.query_sample.append((body, reply))
 
 
 class _Session:
@@ -411,12 +437,12 @@ class _Session:
             item = await self.queue.get()
             if item is None:
                 return
-            t_arr, first_id, n, body = item
+            t_arr, op, first_id, n, body = item
             try:
                 for _ in range(3):  # eviction/rotation → re-register → resend
                     try:
                         await self.register()
-                        await self.roundtrip(Operation.CREATE_TRANSFERS, body)
+                        reply = await self.roundtrip(op, body)
                         break
                     except _Evicted:
                         stats.evictions += 1
@@ -435,9 +461,14 @@ class _Session:
                 if not self.lg.running:
                     return
                 continue
-            stats.accepted_tx += n
-            stats.perceived.append(time.perf_counter() - t_arr)
-            stats.record_acked(first_id, n)
+            if op == Operation.QUERY_TRANSFERS:
+                stats.queries_ok += 1
+                stats.query_perceived.append(time.perf_counter() - t_arr)
+                stats.record_query(body, reply.body)
+            else:
+                stats.accepted_tx += n
+                stats.perceived.append(time.perf_counter() - t_arr)
+                stats.record_acked(first_id, n)
 
     async def run_closed_loop(self) -> None:
         """Closed-loop driver (saturation probe): offer the next batch
@@ -494,12 +525,16 @@ class LoadGen:
         first_id: int = 1,
         cluster: int = 0,
         request_timeout: Optional[float] = None,
+        read_fraction: float = 0.0,
+        query_limit: int = 256,
     ) -> None:
         self.addresses = list(addresses)
         self.n_sessions = sessions
         self.offered_rate = offered_rate
         self.duration_s = duration_s
         self.ramp_s = ramp_s
+        self.read_fraction = read_fraction
+        self.query_limit = query_limit
         self.churn = list(churn)
         self.factory = _BatchFactory(accounts, batch, zipf_s, seed, first_id)
         self.rng = np.random.default_rng(seed ^ 0x5E55)
@@ -524,7 +559,12 @@ class LoadGen:
     async def _generate_open_loop(self, t_end: float) -> None:
         """Poisson arrivals at offered_rate tx/s, round-robin across
         sessions, stamped at their SCHEDULED time (generator lag counts
-        as queueing — that is the open loop's whole point)."""
+        as queueing — that is the open loop's whole point). With
+        read_fraction > 0 each arrival slot is independently a
+        QUERY_TRANSFERS instead of a transfer batch — reads share the
+        sessions, the queues, and the arrival process with writes, so
+        query latency includes the same queueing a real mixed workload
+        sees."""
         rate_arrivals = self.offered_rate / self.factory.batch
         next_t = time.perf_counter()
         i = 0
@@ -536,11 +576,15 @@ class LoadGen:
             delay = next_t - time.perf_counter()
             if delay > 0:
                 await asyncio.sleep(delay)
-            first_id, n, body = self.factory.make()
-            self.stats.offered_tx += n
-            self.sessions[i % n_sess].queue.put_nowait(
-                (next_t, first_id, n, body)
-            )
+            if self.read_fraction and self.rng.random() < self.read_fraction:
+                body = self.factory.make_query(self.query_limit)
+                self.stats.queries_offered += 1
+                item = (next_t, Operation.QUERY_TRANSFERS, 0, 0, body)
+            else:
+                first_id, n, body = self.factory.make()
+                self.stats.offered_tx += n
+                item = (next_t, Operation.CREATE_TRANSFERS, first_id, n, body)
+            self.sessions[i % n_sess].queue.put_nowait(item)
             i += 1
 
     async def _fire_churn(self, t0: float) -> None:
@@ -659,6 +703,7 @@ class LoadGen:
         st = self.stats
         p = sorted(st.perceived)
         b = sorted(st.blackouts)
+        q = sorted(st.query_perceived)
 
         def pct(q: float, vals=None) -> float:
             return percentile(p if vals is None else vals, q) * 1e3
@@ -693,6 +738,12 @@ class LoadGen:
             "blackout_p50_ms": round(pct(0.50, b), 1),
             "blackout_p99_ms": round(pct(0.99, b), 1),
             "blackout_max_ms": round(b[-1] * 1e3, 1) if b else 0.0,
+            # Mixed-run read side (zeros when read_fraction == 0).
+            "read_fraction": self.read_fraction,
+            "queries_offered": st.queries_offered,
+            "queries_ok": st.queries_ok,
+            "query_perceived_p50_ms": round(pct(0.50, q), 3),
+            "query_perceived_p99_ms": round(pct(0.99, q), 3),
         }
 
 
@@ -788,6 +839,64 @@ def audit(
         "acked_found": found,
         "flight_dumps": dumps,
         "flight_exceptions": exceptions,
+    }
+
+
+def audit_queries(
+    addresses: Sequence[Address], samples: Sequence[Tuple[bytes, bytes]],
+) -> dict:
+    """Serial-oracle byte-identity check for queries answered DURING a
+    mixed run: commit timestamps are strictly monotone, so a query's
+    reply (ascending, the first n matches at its commit point) is
+    exactly the set of matches with timestamp ≤ its own last row's —
+    rows committed after the query all carry larger timestamps. Re-issue
+    each sampled filter serially with timestamp_max pinned to that last
+    timestamp: the reply bytes must match the concurrent reply EXACTLY.
+    Empty replies carry no bounding cursor and are skipped (counted)."""
+    from tigerbeetle_tpu.client import Client
+
+    checked = matched = empty = 0
+    client = Client(addresses)
+    try:
+        for body, reply in samples:
+            rows = np.frombuffer(bytearray(reply), dtype=types.TRANSFER_DTYPE)
+            if len(rows) == 0:
+                empty += 1
+                continue
+            v2 = len(body) == types.QUERY_FILTER_V2_DTYPE.itemsize
+            f = np.frombuffer(
+                bytearray(body),
+                dtype=types.QUERY_FILTER_V2_DTYPE if v2
+                else types.QUERY_FILTER_DTYPE,
+            )[0]
+            again = client.query_transfers(
+                user_data_128=int(f["user_data_128_lo"])
+                | (int(f["user_data_128_hi"]) << 64),
+                user_data_64=int(f["user_data_64"]),
+                user_data_32=int(f["user_data_32"]),
+                ledger=int(f["ledger"]), code=int(f["code"]),
+                timestamp_min=int(f["timestamp_min"]),
+                timestamp_max=int(rows["timestamp"][-1]),
+                limit=int(f["limit"]), flags=int(f["flags"]),
+                debit_account_id=(
+                    int(f["debit_account_id_lo"])
+                    | (int(f["debit_account_id_hi"]) << 64) if v2 else 0
+                ),
+                credit_account_id=(
+                    int(f["credit_account_id_lo"])
+                    | (int(f["credit_account_id_hi"]) << 64) if v2 else 0
+                ),
+            )
+            checked += 1
+            if again.tobytes() == rows.tobytes():
+                matched += 1
+    finally:
+        client.close()
+    return {
+        "ok": int(checked == matched),
+        "queries_checked": checked,
+        "queries_matched": matched,
+        "queries_empty_skipped": empty,
     }
 
 

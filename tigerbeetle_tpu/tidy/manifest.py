@@ -89,6 +89,7 @@ JAXLINT_MODULES = (
     "tigerbeetle_tpu/ops/commit_exact.py",
     "tigerbeetle_tpu/ops/merge.py",
     "tigerbeetle_tpu/ops/qindex.py",
+    "tigerbeetle_tpu/ops/scanops.py",
     "tigerbeetle_tpu/models/state_machine.py",
     "tigerbeetle_tpu/parallel/sharding.py",
     "tigerbeetle_tpu/parallel/sharded_ops.py",
@@ -109,6 +110,7 @@ JIT_ENTRIES = {
     "compact_fold_kernel": (),
     "query_index_keys": (),
     "query_index_keys_sorted": (),
+    "scan_intersect_mask": (),
 }
 
 # (repo-relative file, qualified function) pairs forming the SANCTIONED
@@ -131,13 +133,17 @@ JAXLINT_SYNC_SEAM = frozenset((
     # The streaming-compaction device fold's only sync point: the back
     # half of the split-phase double buffer (_CompactionJob._flush_pending).
     ("tigerbeetle_tpu/ops/merge.py", "compact_fold_materialize"),
+    # The device scan-intersect's only sync point: mask compression on
+    # the QUERY path (read-side, like store_barrier — never the commit
+    # path, which does not call into ops/scanops at all).
+    ("tigerbeetle_tpu/ops/scanops.py", "finish_intersect"),
 ))
 
 # Functions whose results count as shape-stabilized (bucket-padded):
 # jit-entry arguments produced by these escape the retrace-shape rule.
 JAXLINT_PAD_HELPERS = frozenset((
     "_device_batch", "_pad_pow2", "_pad_slots", "_stack_pow2", "pad1",
-    "p1", "stage_query_batch", "to_device_run",
+    "p1", "stage_query_batch", "to_device_run", "_pad_sorted_u32",
 ))
 
 # --- absint: limb-width abstract interpretation scope --------------------

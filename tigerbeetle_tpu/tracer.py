@@ -945,6 +945,15 @@ def lifecycle_summary() -> dict:
         flat[key] = s["mean_ms"]
         flat[f"{key}_p50"] = s["p50_ms"]
         flat[f"{key}_p99"] = s["p99_ms"]
+    # Multi-predicate query engine (models/state_machine.query_transfers,
+    # docs/QUERY.md): whole-query latency from the sm.query span — plan,
+    # driver scan, probes, limit-aware gather. query_p50_ms/query_p99_ms
+    # are gated by tools/bench_gate.py (query section, lower-better).
+    s = stats("sm.query")
+    if s is not None:
+        flat["query_ms"] = s["mean_ms"]
+        flat["query_p50_ms"] = s["p50_ms"]
+        flat["query_p99_ms"] = s["p99_ms"]
     # Cluster-plane replication rows (vsr/peerstats.py, primary only;
     # absent on single-replica runs): broadcast→prepare_ok arrival over
     # every REMOTE peer ack (replication lag as a latency distribution)

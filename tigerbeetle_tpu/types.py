@@ -101,6 +101,35 @@ QUERY_FILTER_DTYPE = np.dtype(
 )
 assert QUERY_FILTER_DTYPE.itemsize == 64
 
+# QueryFilter v2 (round-21 multi-predicate scan engine): the v1 shape
+# extended with debit/credit account-id equality predicates, served by
+# the exact-key account_rows index (docs/QUERY.md predicate→index map).
+# The v1 prefix is BYTE-IDENTICAL, so the replica decodes by body size
+# (vsr/replica._event_dtype) and v1 clients never change; clients send
+# v2 only when an account predicate is present (client._query_body).
+QUERY_FILTER_V2_DTYPE = np.dtype(
+    [
+        ("user_data_128_lo", "<u8"), ("user_data_128_hi", "<u8"),
+        ("user_data_64", "<u8"),
+        ("user_data_32", "<u4"),
+        ("ledger", "<u4"),
+        ("code", "<u2"),
+        ("reserved", "V6"),
+        ("timestamp_min", "<u8"),
+        ("timestamp_max", "<u8"),
+        ("limit", "<u4"),
+        ("flags", "<u4"),
+        ("debit_account_id_lo", "<u8"), ("debit_account_id_hi", "<u8"),
+        ("credit_account_id_lo", "<u8"), ("credit_account_id_hi", "<u8"),
+        ("reserved2", "V32"),
+    ]
+)
+assert QUERY_FILTER_V2_DTYPE.itemsize == 128
+assert (
+    QUERY_FILTER_V2_DTYPE.names[: len(QUERY_FILTER_DTYPE.names)]
+    == QUERY_FILTER_DTYPE.names
+)
+
 # (index: u32, result: u32) — reference tigerbeetle.zig:247-266.
 EVENT_RESULT_DTYPE = np.dtype([("index", "<u4"), ("result", "<u4")])
 assert EVENT_RESULT_DTYPE.itemsize == 8

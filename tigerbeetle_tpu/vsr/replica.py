@@ -94,7 +94,7 @@ def _parse_headers(body: bytes) -> List[Header]:
     return [Header(recs[i]) for i in range(n)]
 
 
-def _event_dtype(operation: int) -> np.dtype:
+def _event_dtype(operation: int, body_len: int = -1) -> np.dtype:
     if operation == Operation.CREATE_ACCOUNTS:
         return types.ACCOUNT_DTYPE
     if operation == Operation.CREATE_TRANSFERS:
@@ -102,6 +102,12 @@ def _event_dtype(operation: int) -> np.dtype:
     if operation in (Operation.LOOKUP_ACCOUNTS, Operation.LOOKUP_TRANSFERS):
         return types.ID_DTYPE
     if operation in (Operation.QUERY_ACCOUNTS, Operation.QUERY_TRANSFERS):
+        # Size-discriminated filter version: the v2 shape (account-id
+        # predicates, round-21 scan engine) is a strict byte-superset of
+        # v1, so the body length IS the version tag and v1 clients need
+        # no change (_request_valid admits exactly the two sizes).
+        if body_len == types.QUERY_FILTER_V2_DTYPE.itemsize:
+            return types.QUERY_FILTER_V2_DTYPE
         return types.QUERY_FILTER_DTYPE
     return types.ACCOUNT_FILTER_DTYPE
 
@@ -972,7 +978,10 @@ class Replica:
             if len(body) != types.ACCOUNT_FILTER_DTYPE.itemsize:
                 return False
         elif operation in (Operation.QUERY_ACCOUNTS, Operation.QUERY_TRANSFERS):
-            if len(body) != types.QUERY_FILTER_DTYPE.itemsize:
+            if len(body) not in (
+                types.QUERY_FILTER_DTYPE.itemsize,
+                types.QUERY_FILTER_V2_DTYPE.itemsize,
+            ):
                 return False
         elif operation >= 128:
             ev_size = _event_dtype(operation).itemsize
@@ -1052,7 +1061,10 @@ class Replica:
         self.op += 1
         rh = request.header
         n_events = (
-            (rh["size"] - hdr.HEADER_SIZE) // _event_dtype(rh["operation"]).itemsize
+            (rh["size"] - hdr.HEADER_SIZE)
+            // _event_dtype(
+                rh["operation"], int(rh["size"]) - hdr.HEADER_SIZE
+            ).itemsize
             if rh["operation"] >= 128
             else 0
         )
@@ -1707,7 +1719,9 @@ class Replica:
             lc = msg.lifecycle = tracer.op_begin()
             n_events = (
                 (int(h["size"]) - hdr.HEADER_SIZE)
-                // _event_dtype(h["operation"]).itemsize
+                // _event_dtype(
+                    h["operation"], int(h["size"]) - hdr.HEADER_SIZE
+                ).itemsize
                 if h["operation"] >= 128 else 0
             )
             tracer.op_meta(
@@ -3193,7 +3207,9 @@ class Replica:
             # machine never mutates event arrays (failing rows are copied
             # before stamping), and the old bytearray round-trip copied
             # every 1 MiB body once per commit.
-            events = np.frombuffer(body, dtype=_event_dtype(operation))
+            events = np.frombuffer(
+                body, dtype=_event_dtype(operation, len(body))
+            )
             if operation == Operation.CREATE_ACCOUNTS:
                 res = sm.create_accounts(events, timestamp=h["timestamp"])
                 sm.prepare_timestamp = max(sm.prepare_timestamp, h["timestamp"])

@@ -185,6 +185,20 @@ GATED = (
     # asserts the separation; its ratio swings with scheduler jitter).
     ("cluster_plane", "replication_lag_p99_ms", False),
     ("cluster_plane", "quorum_straggler_p99_ms", False),
+    # Multi-predicate query engine (ISSUE 17, bench.py `query` section,
+    # docs/QUERY.md): Zipf-hot 3-predicate filters through the full
+    # StateMachine.query_transfers wire path over a 10M-row preloaded
+    # store. Latency tails lower-better; scan_rows_per_s (driver
+    # candidate rows examined per second of engine wall time in the
+    # like-for-like A/B) higher-better. intersect_speedup_x and
+    # query_hits_avg are recorded but NOT gated (the speedup is an
+    # acceptance-time A/B whose ratio swings with grid-cache residency;
+    # hits track the Zipf draw, not code quality). Absent from pre-query
+    # baselines: n/a, not failure; a crashed query section records no
+    # keys → MISSING → fail-closed.
+    ("query", "query_p50_ms", False),
+    ("query", "query_p99_ms", False),
+    ("query", "scan_rows_per_s", True),
 )
 
 
