@@ -1089,6 +1089,121 @@ def bench_recovery():
     return out
 
 
+def bench_device():
+    """Device-plane observability objectives (docs/OBSERVABILITY.md
+    "Device plane"; devicestats.py): a traced jax-backend StateMachine
+    driving every hot jit entry — account registration, single-phase
+    fast commits, a FORCED depth-2 split-phase dispatch window, and
+    balance reads — then the new keys read back from the tracer/
+    devicestats ledgers. Gated by tools/bench_gate.py:
+    xfer_{h2d,d2h}_gbps_p50 (achieved transfer bandwidth over the
+    dispatch→finish windows, higher better), device_mem_high_water_bytes
+    (owner-tagged ledger peak, lower better — the workload is fixed, so
+    growth means a leaked scratch bucket or run handle), and the
+    per-entry achieved-GB/s pair (create_transfers_fast_gbps /
+    read_balances_gbps — static cost_analysis bytes over measured
+    wall time; recorded only where the backend reports byte counts,
+    absent = n/a). A crashed section records no gated keys → MISSING →
+    fail-closed once a baseline has them."""
+    from tigerbeetle_tpu import devicestats, tracer
+    from tigerbeetle_tpu import types as _types
+    from tigerbeetle_tpu.constants import Config
+    from tigerbeetle_tpu.models.state_machine import StateMachine
+
+    config = Config(
+        name="bench_device", accounts_max=1 << 12, transfers_max=1 << 16,
+        lsm_block_size=1 << 12, grid_block_count=1 << 12,
+        grid_cache_blocks=64, index_memtable_rows=4096,
+    )
+    was_tracing = tracer.enabled()
+    tracer.enable()
+    tracer.reset()
+    devicestats.reset()
+    try:
+        sm = StateMachine(config, backend="jax")
+        n_acc = 1024
+        acc = np.zeros(n_acc, dtype=_types.ACCOUNT_DTYPE)
+        acc["id_lo"] = np.arange(1, n_acc + 1)
+        acc["ledger"] = 1
+        acc["code"] = 10
+        sm.create_accounts(acc, timestamp=n_acc)
+
+        def batch(ids):
+            ev = np.zeros(len(ids), dtype=_types.TRANSFER_DTYPE)
+            ev["id_lo"] = ids
+            ev["debit_account_id_lo"] = 1 + (ids % (n_acc // 2))
+            ev["credit_account_id_lo"] = 1 + n_acc // 2 + (ids % (n_acc // 2))
+            ev["amount_lo"] = 1
+            ev["ledger"] = 1
+            ev["code"] = 7
+            return ev
+
+        # Warm every bucket OUTSIDE the measured ledger window, then
+        # reset: high-water and bandwidth reflect the steady state.
+        nb = 2048
+        sm.create_transfers(batch(np.arange(1, nb + 1)), timestamp=nb)
+        tracer.reset()
+
+        ts = nb + 1
+        batches = 24
+        for i in range(batches):
+            ids = np.arange(ts, ts + nb, dtype=np.uint64)
+            sm.create_transfers(batch(ids), timestamp=int(ts + nb - 1))
+            ts += nb
+        # Forced depth-2 window: dispatch two id-disjoint batches before
+        # finishing either (the split-phase pair the commit pipeline
+        # uses at depth>1); depth_forced proves the overlap happened.
+        depth_forced = 0
+        h1 = sm.create_transfers_dispatch(
+            batch(np.arange(ts, ts + nb, dtype=np.uint64)), int(ts + nb - 1)
+        )
+        ts += nb
+        h2 = sm.create_transfers_dispatch(
+            batch(np.arange(ts, ts + nb, dtype=np.uint64)), int(ts + nb - 1)
+        )
+        ts += nb
+        depth_forced = tracer.device_inflight()["window_depth"]
+        if h1 is not None:
+            sm.create_transfers_finish(h1)
+        if h2 is not None:
+            sm.create_transfers_finish(h2)
+        sm.lookup_accounts(
+            acc["id_lo"][: 256].copy(), np.zeros(256, dtype=np.uint64)
+        )
+
+        snap = tracer.snapshot()
+        xfer = devicestats.xfer_summary(snap)
+        mem = tracer.device_mem_totals()
+        out = {
+            "device_mem_high_water_bytes": mem["high_water_bytes"],
+            "mem_owner_bytes": mem["owners"],
+            "window_depth_forced": depth_forced,
+            "batches": batches + 2,
+        }
+        for k in ("h2d_gbps_p50", "d2h_gbps_p50"):
+            if k in xfer:
+                out["xfer_" + k[:3] + "_gbps_p50"] = xfer[k]
+        if "bytes_per_transfer" in xfer:
+            out["bytes_per_transfer"] = xfer["bytes_per_transfer"]
+        # Per-entry achieved bandwidth + roofline bound from the cost
+        # model (n/a rows — no backend byte counts — record nothing).
+        rows = devicestats.cost_table(snap)
+        bounds = {}
+        for r in rows:
+            gbps = r.get("achieved_gbps")
+            if gbps is not None:
+                key = f"{r['entry']}_gbps"
+                out[key] = max(out.get(key, 0.0), gbps)
+            bounds.setdefault(r["entry"], r["bound"])
+        out["roofline_bound"] = bounds
+        return out
+    finally:
+        tracer.reset()
+        devicestats.reset()
+        if not was_tracing:
+            tracer.disable()
+
+
 # Section registry, in execution order. The ordering is load-bearing:
 # the first four fork server/client processes onto this host's cores
 # and the parent must not yet hold jax runtime threads (device dispatch/
@@ -1102,6 +1217,7 @@ SECTIONS = (
     ("overload", bench_overload),
     ("cluster_plane", bench_cluster_plane),
     ("query", bench_query),
+    ("device", bench_device),
     ("config1_default", bench_config1),
     ("config2_zipf", bench_config2_zipf),
     ("config3_linked_pending", lambda: bench_exact("config3")),

@@ -402,7 +402,7 @@ def main(backend="numpy", batches=40, overlap=True, store_async=True,
         "compact.merge": ("lsm.compact.merge",),
         "compact.bloom": ("lsm.compact.bloom",),
         "compact.build": ("lsm.compact.build",),
-        "compact.device": ("device.step.compact_fold",),
+        "compact.device": ("device.step.compact_fold_kernel",),
     }
     if any(span_ms(keys) for keys in compact_rows.values()
            if keys != ("sm.beat",)):
@@ -540,6 +540,9 @@ def main(backend="numpy", batches=40, overlap=True, store_async=True,
     dev_rows = {
         k: v for k, v in snap.items()
         if k.startswith("device.") and v.get("total_ms")
+        # device.xfer.* histograms hold RAW GB/s samples, not durations
+        # — they read back below, never as a step row.
+        and not k.startswith("device.xfer.")
     }
     if dev_rows:
         print("\ndevice steps (per jit entry; step = dispatch->finish):")
@@ -553,6 +556,43 @@ def main(backend="numpy", batches=40, overlap=True, store_async=True,
         h2d = snap.get("device.h2d_bytes", {}).get("count", 0)
         d2h = snap.get("device.d2h_bytes", {}).get("count", 0)
         print(f"  transfers: h2d {h2d / 1e6:.1f} MB, d2h {d2h / 1e6:.1f} MB")
+
+    # Per-entry cost/roofline table (devicestats): static FLOPs/bytes
+    # from cost_analysis joined with the measured wall times above. This
+    # runs AFTER the retrace assert — the lowering it triggers compiles
+    # outside the measured window by construction.
+    from tigerbeetle_tpu import devicestats
+
+    cost_rows = devicestats.cost_table(snap)
+    if cost_rows:
+        print("\ndevice cost/roofline (static cost_analysis x measured "
+              "ms/call; bound = static intensity vs backend balance "
+              "point):")
+        print(f"  {'entry':24s} {'shape':28s} {'ms/call':>8s} "
+              f"{'gflops':>8s} {'gbps':>8s} {'bound':>8s}")
+        for r in cost_rows:
+            shape = r["shape"] if len(r["shape"]) <= 28 else r["shape"][:25] + "..."
+
+            def na(v):
+                return f"{v:.3f}" if isinstance(v, float) else "-"
+
+            print(f"  {r['entry']:24s} {shape:28s} "
+                  f"{na(r['ms_per_call']):>8s} "
+                  f"{na(r.get('achieved_gflops')):>8s} "
+                  f"{na(r.get('achieved_gbps')):>8s} {r['bound']:>8s}")
+        xfer = devicestats.xfer_summary(snap)
+        if xfer.get("h2d_windows") or xfer.get("d2h_windows"):
+            print(f"  xfer bandwidth: h2d p50 "
+                  f"{xfer.get('h2d_gbps_p50', 0.0):.3f} GB/s  d2h p50 "
+                  f"{xfer.get('d2h_gbps_p50', 0.0):.3f} GB/s  "
+                  f"bytes/transfer {xfer.get('bytes_per_transfer', '-')}")
+        mem = tracer.device_mem_totals()
+        if mem["owners"]:
+            owners = ", ".join(
+                f"{o}={b / 1e6:.1f}MB" for o, b in sorted(mem["owners"].items())
+            )
+            print(f"  device mem: {owners}  high-water "
+                  f"{mem['high_water_bytes'] / 1e6:.1f}MB")
 
     # Multi-predicate query engine (docs/QUERY.md): a short post-window
     # probe over the transfers just committed — plan/scan/probe/gather

@@ -176,6 +176,11 @@ def test_flight_latency_trip_and_dump_schema(traced, tmp_path):
     tracer.configure_flight(
         latency_mult=2.0, min_ops=4, directory=str(tmp_path), max_dumps=2
     )
+    # Live device state at trip time (ISSUE 18): a dispatched-but-
+    # unfinished kernel window plus a mem-ledger owner must surface in
+    # the dump's device snapshot.
+    tracer.device_mem_set("balances", 8192)
+    dev_tok = tracer.device_dispatch("create_transfers_fast", h2d_bytes=256)
     for i in range(8):
         scripted_op(i)
     assert tracer.lifecycle_summary()["flight"]["dumps"] == 0
@@ -193,6 +198,14 @@ def test_flight_latency_trip_and_dump_schema(traced, tmp_path):
     assert set(last["stamps"]) == set(tracer.OP_STAMP_NAMES)
     assert last["components"]["op.service.execute"] == pytest.approx(508.0)
     assert last["perceived_ms"] == pytest.approx(518.0)
+    # Device snapshot rides in every dump: open windows + ledger totals.
+    dev = doc["device"]
+    assert dev["inflight"] == {"create_transfers_fast": 1}
+    assert dev["window_depth"] == 1
+    assert dev["mem"]["balances"] == 8192
+    assert dev["mem_total_bytes"] == 8192
+    assert dev["mem_high_water_bytes"] == 8192
+    tracer.device_finish("create_transfers_fast", dev_tok)
     # Perfetto companion rides along (same perf_counter timebase).
     trace = json.loads(
         (tmp_path / (dumps[0].name[:-5] + "_trace.json")).read_text()

@@ -193,8 +193,14 @@ def cmd_start(args) -> int:
             # health) for tools/cluster_top.py and the timebase +
             # offset estimates tools/cluster_trace.py aligns merged
             # traces with.
+            # /device adds the device-plane status (per-kernel
+            # cost/roofline table, memory ledger, transfer bandwidth,
+            # in-flight dispatch windows) for tools/device_top.py —
+            # devicestats never imports jax, so a numpy-backend replica
+            # serves it too.
             import json as _json
 
+            from tigerbeetle_tpu import devicestats
             from tigerbeetle_tpu.vsr import peerstats
 
             routes = {
@@ -204,12 +210,19 @@ def cmd_start(args) -> int:
                     ).encode(),
                     "application/json",
                 ),
+                "/device": lambda: (
+                    _json.dumps(
+                        devicestats.device_status(replica)
+                    ).encode(),
+                    "application/json",
+                ),
             }
             metrics_server = await tracer.serve_metrics(
                 args.metrics_port, extra=routes
             )
             print(f"metrics on http://127.0.0.1:{args.metrics_port}/metrics "
-                  f"(trace: /trace, cluster: /cluster)", flush=True)
+                  f"(trace: /trace, cluster: /cluster, device: /device)",
+                  flush=True)
         print(f"replica {args.replica}/{len(addresses)} listening on {host}:{port} "
               f"(backend={args.backend}, status={replica.status})", flush=True)
         await server.serve_forever()

@@ -1750,12 +1750,15 @@ class _CompactionJob:
 
     def discard_pending(self) -> None:
         """Drop a dispatched-but-unappended device chunk (fault abort
-        path): closes its tracer dispatch token; the retried job simply
-        re-merges the chunk."""
+        path): closes its tracer dispatch token and releases its
+        memory-ledger bytes; the retried job simply re-merges the
+        chunk."""
         if self._pending is None:
             return
+        from tigerbeetle_tpu.ops import merge as merge_ops
+
         handle, self._pending = self._pending, None
-        tracer.device_finish("compact_fold", handle[3])
+        merge_ops.compact_fold_discard(handle)
 
     def prefetch_one(self) -> bool:
         """Warm one upcoming input block (idle read-ahead); see

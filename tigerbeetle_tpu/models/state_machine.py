@@ -25,7 +25,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from tigerbeetle_tpu import tracer, types
+from tigerbeetle_tpu import devicestats, tracer, types
 from tigerbeetle_tpu.tidy import runtime as tidy_runtime
 from tigerbeetle_tpu.constants import (
     Config, PIPELINE_PREPARE_QUEUE_MAX, PRODUCTION,
@@ -194,6 +194,7 @@ class StateMachine:
             config.grid_cache_blocks,
         )
         a = config.accounts_max
+        self._balances_nbytes = 0  # tidy: owner=commit
 
         if backend == "jax":
             from tigerbeetle_tpu.ops import commit as commit_ops
@@ -207,6 +208,12 @@ class StateMachine:
             else:
                 self._ops = commit_ops
             self.state = self._ops.init_state(a)
+            # Device memory ledger: the resident balance tables. Shape
+            # metadata only — `.nbytes` never materializes a device value.
+            self._balances_nbytes = sum(
+                int(getattr(x, "nbytes", 0)) for x in self.state
+            )
+            tracer.device_mem_set("balances", self._balances_nbytes)
         else:  # pure-host backend: balances live in numpy mirrors
             self._ops = None
             self._host_bal = {
@@ -336,6 +343,12 @@ class StateMachine:
             {} for _ in range(DISPATCH_WINDOW_MAX)
         ]
         self._disp_seq = 0  # tidy: owner=commit
+        # Last-use dispatch seq per scratch bucket (pow-2 pad size): a
+        # bucket idle for SCRATCH_STALE_AFTER dispatches is retired —
+        # buffers freed from every ring slot, its device.mem.scratch.*
+        # gauges and devicestats cost rows dropped — so a workload
+        # shift can't grow the ring (or the registry) unbounded.
+        self._scratch_last_use: Dict[int, int] = {}  # tidy: owner=commit
 
         # telemetry: how many batches took which path
         self.stats = {
@@ -735,6 +748,7 @@ class StateMachine:
             slots_p, = self._pad_slots(
                 [np.asarray(slots, dtype=np.int32)], k, [0]
             )
+            devicestats.note_call("read_balances", (self.state, slots_p))
             with tracer.device_step("read_balances"):
                 dp, dpo, cp, cpo = self._ops.read_balances(self.state, slots_p)
                 # Materialize the FULL padded arrays first: the sliced
@@ -763,6 +777,10 @@ class StateMachine:
                 [np.asarray(slots, dtype=np.int32), dp, dpo, cp, cpo],
                 k, [oob, 0, 0, 0, 0],
             )
+            devicestats.note_call(
+                "write_balances",
+                (self.state, slots_p, dp_p, dpo_p, cp_p, cpo_p),
+            )
             with tracer.device_step("write_balances"):
                 self.state = self._ops.write_balances(
                     self.state, slots_p, dp_p, dpo_p, cp_p, cpo_p
@@ -790,6 +808,10 @@ class StateMachine:
                     np.asarray(mask),
                 ],
                 k, [-1, 0, 0, False],
+            )
+            devicestats.note_call(
+                "register_accounts",
+                (self.state, slots_p, ledger_p, flags_p, mask_p),
             )
             with tracer.device_step("register_accounts"):
                 self.state = self._ops.register_accounts(
@@ -1106,6 +1128,10 @@ class StateMachine:
         bail to serial on overflow, store OK rows."""
         n = len(events)
         b, host_code_p = self._device_batch(events, ts, dr_slots, cr_slots, host_code)
+        devicestats.note_call(
+            "create_transfers_fast", (self.state, b, host_code_p),
+            bucket=len(host_code_p),
+        )
         t_disp = tracer.device_dispatch(
             "create_transfers_fast", h2d_bytes=_staged_nbytes(b, host_code_p)
         )
@@ -1208,6 +1234,10 @@ class StateMachine:
                 return None
         ts = np.uint64(timestamp) - np.uint64(n) + 1 + np.arange(n, dtype=np.uint64)
         b, host_code_p = self._device_batch(events, ts, dr_slots, cr_slots, host_code)
+        devicestats.note_call(
+            "create_transfers_fast", (self.state, b, host_code_p),
+            bucket=len(host_code_p),
+        )
         with tracer.span("sm.ct.dispatch"):
             new_state, codes_dev, bail_dev = self._ops.create_transfers_fast(
                 self.state, b, host_code_p
@@ -1478,8 +1508,53 @@ class StateMachine:
                 flags=pad1("flags", events["flags"].astype(np.uint32)),
                 timestamp=pad1("timestamp", types.u64_to_limbs(ts)),
             )
+        self._scratch_note(n_pad)
         b = self._ops.TransferBatch(**cols)
         return b, host_code_p
+
+    # Dispatches a scratch bucket may sit idle before retirement. Large
+    # enough that a bucket in ANY live dispatch window (≤ DISPATCH_
+    # WINDOW_MAX old) can never be reclaimed under a kernel; small
+    # enough that a workload shift frees the old buckets within one
+    # bench section. Class attribute so tests can force fast churn.
+    SCRATCH_STALE_AFTER = 512
+
+    def _scratch_note(self, n_pad: int) -> None:
+        """Device-memory-ledger upkeep per dispatch: stamp the bucket's
+        last use, publish its live bytes (summed over every ring slot)
+        as the `device.mem.scratch.b<n_pad>.bytes` gauge, and retire
+        buckets the workload stopped using (satellite: the registry and
+        the ring stay bounded under bucket churn)."""
+        self._scratch_last_use[n_pad] = self._disp_seq
+        if tracer.enabled():
+            nbytes = sum(
+                a.nbytes
+                for slot in self._disp_scratch
+                for (_, bkt), a in slot.items()
+                if bkt == n_pad
+            )
+            tracer.device_mem_set(f"scratch.b{n_pad}", nbytes)
+            tracer.device_mem_set("balances", self._balances_nbytes)
+        if len(self._scratch_last_use) > 1:
+            stale = [
+                b for b, last in self._scratch_last_use.items()
+                if self._disp_seq - last > self.SCRATCH_STALE_AFTER
+            ]
+            for b in stale:
+                self._scratch_retire(b)
+
+    def _scratch_retire(self, n_pad: int) -> None:
+        """Free one stale bucket: its staging buffers in every ring
+        slot, its owner gauge, and its devicestats shape/cost rows.
+        Safe by construction — a bucket referenced by an in-flight
+        handle was used within DISPATCH_WINDOW_MAX dispatches, far
+        inside SCRATCH_STALE_AFTER."""
+        for slot in self._disp_scratch:
+            for key in [k for k in slot if k[1] == n_pad]:
+                del slot[key]
+        self._scratch_last_use.pop(n_pad, None)
+        tracer.device_mem_retire_prefix(f"scratch.b{n_pad}")
+        devicestats.retire_bucket(n_pad)
 
     # Device-batch SoA columns: (trailing shape, dtype, padding fill).
     _DISPATCH_COLS = {
@@ -1702,20 +1777,43 @@ class StateMachine:
             pinfo.dr_slot, pinfo.cr_slot, chain_id_p, pinfo.group,
             int(self.state.ledger.shape[0]),
         )
+        has_pv, has_chains = bool(np.any(is_pv)), bool(np.any(linked))
+        devicestats.note_call(
+            "create_transfers_exact",
+            (self.state, b, host_code_p, pinfo, chain_id_p, plan),
+            kwargs=dict(has_pv=has_pv, has_chains=has_chains),
+            bucket=n_pad,
+        )
+        t_disp = tracer.device_dispatch(
+            "create_transfers_exact",
+            h2d_bytes=_staged_nbytes(b, host_code_p)
+            + _staged_nbytes(pinfo, chain_id_p) + _staged_nbytes(plan, 0),
+        )
         new_state, codes_dev, amounts_dev, dr_after, cr_after, bail = (
             self._ops.create_transfers_exact(
                 self.state, b, host_code_p, pinfo, chain_id_p, plan,
                 # tidy: allow=retrace-static-arg — deliberate bounded specialization: two bools → at most 4 kernel variants, each skipping a whole sweep phase
-                has_pv=bool(np.any(is_pv)), has_chains=bool(np.any(linked)),
+                has_pv=has_pv, has_chains=has_chains,
             )
         )
         if bool(bail):
+            # The bail sync ends the device step (same close-on-bail rule
+            # as _commit_fast_device, or dispatch/step counters diverge).
+            tracer.device_finish("create_transfers_exact", t_disp)
             self.stats["bail_batches"] += 1
             return self._create_transfers_serial(events, timestamp)
         self.state = new_state
         self.stats["exact_batches"] += 1
-        codes = np.asarray(codes_dev)[:n]
-        amounts = np.asarray(amounts_dev)[:n]
+        # Materialize the FULL padded arrays first: sliced views would
+        # undercount the device→host volume (same rule as _read_balances).
+        codes_h = np.asarray(codes_dev)
+        amounts_h = np.asarray(amounts_dev)
+        tracer.device_finish(
+            "create_transfers_exact", t_disp,
+            d2h_bytes=codes_h.nbytes + amounts_h.nbytes,
+        )
+        codes = codes_h[:n]
+        amounts = amounts_h[:n]
         amt_lo, amt_hi = types.limbs_to_u64_pair(amounts)
 
         ok = codes == 0

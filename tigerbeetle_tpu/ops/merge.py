@@ -44,7 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tigerbeetle_tpu import tracer
+from tigerbeetle_tpu import devicestats, tracer
 from tigerbeetle_tpu.ops import u128
 
 I32 = jnp.int32
@@ -275,6 +275,7 @@ def merge_device(keys_a, vals_a, keys_b, vals_b):
     n, m = len(keys_a), len(keys_b)
     ka, pa = to_device_run(keys_a, vals_a)
     kb, pb = to_device_run(keys_b, vals_b)
+    devicestats.note_call("merge_kernel_tiled", (ka, pa, kb, pb))
     ok, op = merge_kernel_tiled(ka, pa, kb, pb)
     return from_device_run(ok, op, n + m)
 
@@ -335,11 +336,22 @@ def compact_fold_dispatch(parts_k, parts_v):
     (the handle is resolved by compact_fold_materialize, typically one
     chunk later so the transfer overlaps the next chunk's merge)."""
     ks, ps, total = _stack_pow2(parts_k, parts_v)
+    devicestats.note_call("compact_fold_kernel", (ks, ps))
     t_disp = tracer.device_dispatch(
-        "compact_fold", h2d_bytes=ks.nbytes + ps.nbytes
+        "compact_fold_kernel", h2d_bytes=ks.nbytes + ps.nbytes
     )
     keys_dev, pays_dev = compact_fold_kernel(ks, ps)
+    # Memory ledger: the fold's device-resident output lives until the
+    # handle is materialized or discarded. `.nbytes` is shape metadata
+    # — never a sync.
+    tracer.device_mem_adjust("compact_fold", _fold_nbytes(keys_dev, pays_dev))
     return keys_dev, pays_dev, total, t_disp
+
+
+def _fold_nbytes(keys_dev, pays_dev) -> int:
+    return int(
+        getattr(keys_dev, "nbytes", 0) + getattr(pays_dev, "nbytes", 0)
+    )
 
 
 def compact_fold_materialize(handle):
@@ -349,9 +361,20 @@ def compact_fold_materialize(handle):
     ok = np.asarray(keys_dev)
     op = np.asarray(pays_dev)
     tracer.device_finish(
-        "compact_fold", t_disp, d2h_bytes=ok.nbytes + op.nbytes
+        "compact_fold_kernel", t_disp, d2h_bytes=ok.nbytes + op.nbytes
     )
+    tracer.device_mem_adjust("compact_fold", -_fold_nbytes(keys_dev, pays_dev))
     return from_device_run(ok.reshape(-1, 3), op.reshape(-1, 3), total)
+
+
+def compact_fold_discard(handle) -> None:
+    """Close a dispatched fold handle WITHOUT materializing it (the
+    fault-abort path, lsm/tree.py discard_pending): closes the dispatch
+    window and returns the chunk's ledger bytes. Metadata reads only —
+    discarding must never force the sync it exists to avoid."""
+    keys_dev, pays_dev, _total, t_disp = handle
+    tracer.device_finish("compact_fold_kernel", t_disp)
+    tracer.device_mem_adjust("compact_fold", -_fold_nbytes(keys_dev, pays_dev))
 
 
 # Host-side stable k-way merge: lives in lsm/store.py (jax-free, next to

@@ -45,7 +45,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tigerbeetle_tpu import tracer
+from tigerbeetle_tpu import devicestats, tracer
 from tigerbeetle_tpu.lsm import scan
 from tigerbeetle_tpu.ops.merge import bucket_pow2
 
@@ -207,6 +207,13 @@ class QueryKeyRun:
         self._entry = entry
         self._t_disp = t_disp
         self._host: tuple | None = None
+        # Memory ledger: this run's device-resident key/payload bytes,
+        # released exactly once when the handles drop (materialize) or
+        # the fold consumes the run on-chip (finish_dispatch).
+        self._ledger_bytes = int(
+            getattr(keys_dev, "nbytes", 0) + getattr(pay_dev, "nbytes", 0)
+        )
+        tracer.device_mem_adjust("query_runs", self._ledger_bytes)
         # materialize() can race itself: the store stage's idle prefetch
         # pulls the transfer forward while a barrier-synchronized reader
         # (commit thread) resolves the same run. One lock per run — both
@@ -240,6 +247,7 @@ class QueryKeyRun:
                     self._entry, self._t_disp, d2h_bytes=d2h_bytes
                 )
                 self._t_disp = 0
+            self._ledger_release()
 
     def _materialize_locked(self):
         if self._host is not None:
@@ -267,7 +275,15 @@ class QueryKeyRun:
 
         self._host = from_device_run(ok, op, self.n)
         self._keys_dev = self._pay_dev = None
+        self._ledger_release()
         return self._host
+
+    def _ledger_release(self) -> None:
+        """Return this run's bytes to the query_runs ledger owner, once
+        (callers hold self._lock)."""
+        if self._ledger_bytes:
+            tracer.device_mem_adjust("query_runs", -self._ledger_bytes)
+            self._ledger_bytes = 0
 
     @property
     def materialized(self) -> bool:
@@ -283,6 +299,7 @@ def build_run(recs: np.ndarray, rows: np.ndarray,
         "query_index_keys_sorted" if use_device_sort else "query_index_keys"
     )
     h2d = cols.nbytes + ts.nbytes + rows_p.nbytes + pad.nbytes
+    devicestats.note_call(entry, (cols, ts, rows_p, pad))
     t_disp = tracer.device_dispatch(entry, h2d_bytes=h2d)
     if use_device_sort:
         keys_dev, pay_dev = query_index_keys_sorted(cols, ts, rows_p, pad)
@@ -309,6 +326,7 @@ def fold_runs_device(runs):
     ka, pa = runs[0].device_run()
     for r in runs[1:]:
         kb, pb = r.device_run()
+        devicestats.note_call("merge_kernel_tiled", (ka, pa, kb, pb))
         ka, pa = merge_kernel_tiled(ka, pa, kb, pb)
     return ka, pa, sum(r.n for r in runs)
 

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tigerbeetle_tpu import tracer
+from tigerbeetle_tpu import devicestats, tracer
 from tigerbeetle_tpu.ops.merge import bucket_pow2
 
 # Row-id pad sentinel: object-log rows are u32 row indices and
@@ -81,6 +81,7 @@ def intersect_sorted_device(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     cand, run = (a, b) if na <= nb else (b, a)
     cand_p = _pad_sorted_u32(np.ascontiguousarray(cand, dtype=np.uint32))
     run_p = _pad_sorted_u32(np.ascontiguousarray(run, dtype=np.uint32))
+    devicestats.note_call("scan_intersect_mask", (cand_p, run_p))
     t_disp = tracer.device_dispatch(
         "scan_intersect_mask", h2d_bytes=cand_p.nbytes + run_p.nbytes
     )

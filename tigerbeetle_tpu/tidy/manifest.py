@@ -62,6 +62,7 @@ OWNERSHIP_CLASSES = {
 # value = default role for the module's functions.
 OWNERSHIP_MODULES = {
     "tigerbeetle_tpu/tracer.py": "any",
+    "tigerbeetle_tpu/devicestats.py": "any",
 }
 
 # --- determinism lint scope ---------------------------------------------

@@ -43,6 +43,11 @@ loop and their stall/idle time decides throughput:
     h2d/d2h transfer byte counters, entry names validated against the
     jaxlint JIT_ENTRIES manifest so kernel work is always attributable
     to a manifest-declared entry point.
+  - Device-plane ledgers (devicestats.py surfaces these): owner-tagged
+    device-memory gauges with high-water tracking, per-entry transfer
+    bandwidth histograms stamped at the sanctioned sync seams, open
+    dispatch-window accounting (flight dumps include it), and the
+    Perfetto async device lane built from closed dispatch→finish pairs.
 
 Thread model: every recording path (span/count/observe) writes only
 thread-local state created lazily per thread and registered for merge;
@@ -119,6 +124,20 @@ _generation = 0
 # iterates on the loop — so even the single-key set takes the lock: an
 # unlocked dict resize racing `sorted(_gauges)` raises RuntimeError.
 _gauges: Dict[str, float] = {}  # tidy: guarded-by=_registry_lock
+# Device-plane ledgers (ISSUE 18, docs/OBSERVABILITY.md "Device plane").
+# _device_mem: owner tag -> live device bytes (scratch ring buckets,
+# balance tables, lazy query runs, compaction fold chunks); each write
+# republishes the owner's `device.mem.<owner>.bytes` gauge and advances
+# the high-water total. _device_inflight: entry -> {dispatch token:
+# h2d bytes} — open dispatch windows, popped at the sanctioned finish
+# seam (bounded per entry: an abandoned token is evicted, never leaked).
+# _device_pairs: bounded ring of closed (entry, t0, t1, h2d, d2h)
+# dispatch→finish windows feeding the Perfetto async device lane.
+_device_mem: Dict[str, int] = {}  # tidy: guarded-by=_registry_lock
+_device_mem_hw = [0]  # tidy: guarded-by=_registry_lock
+_device_inflight: Dict[str, Dict[int, int]] = {}  # tidy: guarded-by=_registry_lock
+_DEVICE_INFLIGHT_MAX = 64  # per entry; beyond = abandoned tokens
+_device_pairs: deque = deque(maxlen=4096)  # tidy: guarded-by=_registry_lock
 _tls = threading.local()
 
 
@@ -257,6 +276,11 @@ def reset() -> None:
         _flight["dumps"] = 0
         _flight["exception_dumps"] = 0
         _flight["last_dump_ns"] = 0
+        # Device-plane ledgers re-arm with the registry.
+        _device_mem.clear()
+        _device_mem_hw[0] = 0
+        _device_inflight.clear()
+        _device_pairs.clear()
 
 
 def configure(ring_size: Optional[int] = None) -> None:
@@ -342,6 +366,90 @@ def remove_gauges_prefix(prefix: str) -> None:
 def gauges() -> Dict[str, float]:
     with _registry_lock:
         return dict(_gauges)
+
+
+# --- device memory ledger (owner-tagged live device bytes) ---------------
+#
+# Who holds device memory right now, by owner tag: the dispatch scratch
+# ring's generation-keyed buckets (`scratch.<entry>.b<n_pad>`), the
+# resident balance tables (`balances`), lazy query-key runs
+# (`query_runs`), and in-flight compaction fold chunks (`compact_fold`).
+# Byte counts are `.nbytes` shape metadata — never a device sync — and
+# every write republishes the owner's `device.mem.<owner>.bytes` gauge
+# so the ledger rides the ordinary scrape surface. The high-water mark
+# is the lifecycle flat key `device_mem_high_water_bytes` (bench-gated).
+
+
+def device_mem_set(owner: str, nbytes: int) -> None:
+    """Set an owner's live device bytes (absolute)."""
+    if not _enabled:
+        return
+    with _registry_lock:
+        _device_mem[owner] = int(nbytes)
+        _gauges[f"device.mem.{owner}.bytes"] = float(nbytes)
+        total = sum(_device_mem.values())
+        if total > _device_mem_hw[0]:
+            _device_mem_hw[0] = total
+
+
+def device_mem_adjust(owner: str, delta: int) -> None:
+    """Adjust an owner's live device bytes by a delta (clamped at 0 —
+    a release racing a reset must not publish negative residency)."""
+    if not _enabled:
+        return
+    with _registry_lock:
+        v = max(0, _device_mem.get(owner, 0) + int(delta))
+        _device_mem[owner] = v
+        _gauges[f"device.mem.{owner}.bytes"] = float(v)
+        total = sum(_device_mem.values())
+        if total > _device_mem_hw[0]:
+            _device_mem_hw[0] = total
+
+
+def device_mem_release(owner: str) -> None:
+    """Drop an owner whose device allocation died, gauge included."""
+    if not _enabled:
+        return
+    with _registry_lock:
+        _device_mem.pop(owner, None)
+        _gauges.pop(f"device.mem.{owner}.bytes", None)
+
+
+def device_mem_retire_prefix(prefix: str) -> None:
+    """Retire every ledger owner (and gauge) under a tag prefix — the
+    scratch-ring bucket families (`scratch.<entry>.b<n_pad>`) when a
+    workload shift strands a bucket shape that is never reused: the
+    ledger and the gauge registry must stay bounded under bucket churn
+    (same leak class as the per-peer gauge retirement)."""
+    if not _enabled:
+        return
+    with _registry_lock:
+        for owner in [o for o in _device_mem if o.startswith(prefix)]:
+            del _device_mem[owner]
+        gp = f"device.mem.{prefix}"
+        for name in [n for n in _gauges if n.startswith(gp)]:
+            del _gauges[name]
+
+
+def device_mem_totals() -> dict:
+    """Ledger snapshot: per-owner live bytes, the live total, and the
+    process high-water total (monotone until reset)."""
+    with _registry_lock:
+        owners = dict(_device_mem)
+        hw = _device_mem_hw[0]
+    return {
+        "owners": owners,
+        "total_bytes": sum(owners.values()),
+        "high_water_bytes": hw,
+    }
+
+
+def device_inflight() -> dict:
+    """Open dispatch windows right now: per-entry count of dispatched-
+    but-unfinished tokens, plus the total window depth."""
+    with _registry_lock:
+        per = {e: len(toks) for e, toks in _device_inflight.items() if toks}
+    return {"entries": per, "window_depth": sum(per.values())}
 
 
 # --- per-operation lifecycle (queue-wait vs service decomposition) ------
@@ -783,6 +891,15 @@ def flight_trip(reason: str) -> Optional[str]:
         # evict-and-recycle must not mix two ops into one dump record.
         recs = [op_record_dict(r) for r in _op_ring]
         directory = _flight["dir"]
+        # Device state at trip time (ISSUE 18): open dispatch windows
+        # per entry, total window depth, and the memory-ledger totals —
+        # an anomaly dump must show what the device was holding/running
+        # when the tail event landed.
+        dev_inflight = {
+            e: len(toks) for e, toks in _device_inflight.items() if toks
+        }
+        dev_mem = dict(_device_mem)
+        dev_hw = _device_mem_hw[0]
     if not directory:
         import tempfile
 
@@ -792,6 +909,13 @@ def flight_trip(reason: str) -> Optional[str]:
         "reason": reason,
         "tripped_ns": now,
         "ops": recs,
+        "device": {
+            "inflight": dev_inflight,
+            "window_depth": sum(dev_inflight.values()),
+            "mem": dev_mem,
+            "mem_total_bytes": sum(dev_mem.values()),
+            "mem_high_water_bytes": dev_hw,
+        },
     }
     try:
         with open(base + ".json", "w") as f:
@@ -987,8 +1111,15 @@ def lifecycle_summary() -> dict:
             )
     with _registry_lock:
         depth_cfg = _gauges.get("pipeline.commit.depth_config")
+        device_hw = _device_mem_hw[0]
     if depth_cfg is not None:
         flat["commit_depth"] = float(depth_cfg)
+    # Device memory high-water (ISSUE 18, docs/OBSERVABILITY.md "Device
+    # plane"): peak simultaneous owner-tagged device bytes — bench.py's
+    # device section records it and tools/bench_gate.py gates it
+    # lower-better. Absent when no owner ever registered (numpy backend).
+    if device_hw > 0:
+        flat["device_mem_high_water_bytes"] = float(device_hw)
     # Stage occupancy: mean prepares resident per pipeline stage (wait +
     # service of that stage), plus the whole arrive→reply window.
     occupancy.update(_stage_occupancy(
@@ -1054,25 +1185,52 @@ def device_step(entry: str):
 def device_dispatch(entry: str, h2d_bytes: int = 0) -> int:
     """Mark an async kernel dispatch; returns the dispatch timestamp
     token for device_finish (0 when disabled). Counts the host→device
-    bytes staged for the call."""
+    bytes staged for the call and opens an in-flight window (the staged
+    bytes ride the token so the finish seam can attribute h2d bandwidth
+    over the same dispatch→finish interval)."""
     if not _enabled:
         return 0
     _device_entry_check(entry)
     count(f"device.{entry}.dispatches")
     if h2d_bytes:
         count("device.h2d_bytes", h2d_bytes)
-    return time.perf_counter_ns()
+    token = time.perf_counter_ns()
+    with _registry_lock:
+        toks = _device_inflight.setdefault(entry, {})
+        toks[token] = h2d_bytes
+        while len(toks) > _DEVICE_INFLIGHT_MAX:
+            # Abandoned dispatches (e.g. a bail-path abandon_all that
+            # never reaches a finish seam) must not grow the map.
+            del toks[next(iter(toks))]
+    return token
 
 
 def device_finish(entry: str, token: int, d2h_bytes: int = 0) -> None:
     """Close a dispatch: `device.step.<entry>` is the dispatch→finish
     latency — the device execution window isolated from host time
-    between the two calls."""
+    between the two calls. Stamped only at the sanctioned sync seams
+    (tidy/manifest.JAXLINT_SYNC_SEAM), so the transfer-bandwidth
+    attribution below never adds a sync of its own:
+    `device.xfer.{h2d,d2h}.gbps` histograms hold RAW values in MB/s
+    (= GB/s × 1000 — snapshot()'s `p50_us` field therefore reads
+    directly as GB/s), and the closed window feeds the Perfetto async
+    device lane ring."""
     if not _enabled or not token:
         return
-    observe(f"device.step.{entry}", time.perf_counter_ns() - token)
+    now = time.perf_counter_ns()
+    dur = now - token
+    observe(f"device.step.{entry}", dur)
     if d2h_bytes:
         count("device.d2h_bytes", d2h_bytes)
+    with _registry_lock:
+        toks = _device_inflight.get(entry)
+        h2d_bytes = toks.pop(token, 0) if toks else 0
+        _device_pairs.append((entry, token, now, h2d_bytes, d2h_bytes))
+    if dur > 0:
+        if h2d_bytes:
+            observe("device.xfer.h2d.gbps", max(1, h2d_bytes * 1000 // dur))
+        if d2h_bytes:
+            observe("device.xfer.d2h.gbps", max(1, d2h_bytes * 1000 // dur))
 
 
 def device_bytes(h2d: int = 0, d2h: int = 0) -> None:
@@ -1221,6 +1379,19 @@ def export_trace() -> dict:
             "name": event, "cat": "tbtpu", "ph": "X", "pid": pid,
             "tid": tid, "ts": t0 / 1e3, "dur": (t1 - t0) / 1e3,
         })
+    # Device lane (ISSUE 18): every closed dispatch→finish window as an
+    # async span pair ('b'/'e', one id per window) so depth-N overlap is
+    # VISIBLE — two in-flight dispatches of the same entry render as
+    # overlapping spans on the entry's async track, which the per-thread
+    # 'X' rows above structurally cannot show.
+    with _registry_lock:
+        pairs = list(_device_pairs)
+    for i, (entry, t0, t1, h2d, d2h) in enumerate(pairs):
+        common = {"name": entry, "cat": "device", "pid": pid, "tid": 0,
+                  "id": i}
+        evs.append({**common, "ph": "b", "ts": t0 / 1e3,
+                    "args": {"h2d_bytes": h2d, "d2h_bytes": d2h}})
+        evs.append({**common, "ph": "e", "ts": t1 / 1e3})
     # Timebase anchor: span timestamps are perf_counter_ns (process-
     # local). Pairing one perf reading with the wall clock lets
     # tools/cluster_trace.py map every event onto a shared wall

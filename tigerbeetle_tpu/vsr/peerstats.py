@@ -228,4 +228,15 @@ def cluster_status(replica, server=None) -> dict:
             "skew_bound_ms": round(cs.skew_bound_ns / 1e6, 3),
             "sources": cs.sources,
         }
+    # Device-plane summary (tracer-side ledgers only — no devicestats
+    # import, so a numpy-backend replica answers without touching jax):
+    # cluster_top renders these as optional columns, n/a when absent.
+    mem = tracer.device_mem_totals()
+    inflight = tracer.device_inflight()
+    if mem["owners"] or inflight["window_depth"]:
+        out["device"] = {
+            "mem_high_water_bytes": mem["high_water_bytes"],
+            "mem_total_bytes": mem["total_bytes"],
+            "inflight_depth": inflight["window_depth"],
+        }
     return out

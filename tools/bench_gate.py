@@ -199,6 +199,25 @@ GATED = (
     ("query", "query_p50_ms", False),
     ("query", "query_p99_ms", False),
     ("query", "scan_rows_per_s", True),
+    # Device-plane observability (ISSUE 18, bench.py `device` section,
+    # docs/OBSERVABILITY.md "Device plane"): a traced jax StateMachine
+    # workload with a forced depth-2 dispatch window. The transfer-
+    # bandwidth p50s (achieved GB/s over the dispatch→finish windows,
+    # per direction) are higher-better; device_mem_high_water_bytes —
+    # the owner-tagged ledger's peak — is lower-better (footprint
+    # regression guard; the workload is fixed, so growth means a leaked
+    # scratch bucket or run handle). The per-entry achieved-GB/s keys
+    # (cost-model bytes over measured wall time) are higher-better but
+    # only recorded when the backend's cost_analysis reports byte
+    # counts — absent on such backends: n/a, not failure. All keys
+    # absent from pre-device-plane baselines (BENCH_r06 and earlier):
+    # n/a, not failure; a crashed device section records no gated keys
+    # → MISSING → fail-closed once a baseline has them.
+    ("device", "xfer_h2d_gbps_p50", True),
+    ("device", "xfer_d2h_gbps_p50", True),
+    ("device", "device_mem_high_water_bytes", False),
+    ("device", "create_transfers_fast_gbps", True),
+    ("device", "read_balances_gbps", True),
 )
 
 

@@ -33,23 +33,31 @@ def render(statuses: List[Optional[dict]], ports: List[int]) -> str:
     exactly what the operator is looking for)."""
     lines = [
         f"{'replica':>8s} {'port':>6s} {'status':>12s} {'view':>5s} "
-        f"{'op':>8s} {'commit':>8s} {'skew_ms':>8s}"
+        f"{'op':>8s} {'commit':>8s} {'skew_ms':>8s} "
+        f"{'dev_mem_hw':>10s} {'inflt':>5s}"
     ]
     for i, st in enumerate(statuses):
         port = ports[i] if i < len(ports) else 0
         if st is None:
             lines.append(
                 f"{'?':>8s} {port:6d} {'UNREACHABLE':>12s} "
-                f"{'-':>5s} {'-':>8s} {'-':>8s} {'-':>8s}"
+                f"{'-':>5s} {'-':>8s} {'-':>8s} {'-':>8s} "
+                f"{'-':>10s} {'-':>5s}"
             )
             continue
         role = "primary" if st.get("is_primary") else st.get("status", "?")
         skew = st.get("clock", {}).get("skew_bound_ms")
+        # Device-plane columns are optional: a replica without device
+        # traffic (numpy backend, telemetry off) reports no "device"
+        # block and renders as n/a.
+        dev = st.get("device", {})
         lines.append(
             f"{st.get('replica', '?'):>8} {port:6d} {role:>12s} "
             f"{st.get('view', 0):5d} {st.get('op', 0):8d} "
             f"{st.get('commit_min', 0):8d} "
-            f"{skew if skew is not None else '-':>8}"
+            f"{skew if skew is not None else '-':>8} "
+            f"{dev.get('mem_high_water_bytes', '-'):>10} "
+            f"{dev.get('inflight_depth', '-'):>5}"
         )
     lines.append("")
     lines.append(
