@@ -95,6 +95,7 @@ static inline void wr64(uint8_t *p, uint64_t v) { memcpy(p, &v, 8); }
  */
 #define BUSIO_SCAN_COLS 8
 
+/* tidy: range=len:0..0x40000000,max_frames:0..16384; bound=out:131072,tail:3 — callers cap len at the 1 GiB stream buffer and pass SCAN_MAX_FRAMES x SCAN_COLS u64 scratch + a 3-word tail (net/codec.py FrameScanner) */
 int64_t busio_scan(const uint8_t *buf, uint64_t len, uint64_t *out,
                    int64_t max_frames, uint64_t *tail) {
     uint64_t off = 0;

@@ -348,6 +348,7 @@ static inline int head_lt(merge_head a, merge_head b) {
     return a.lo < b.lo || (a.lo == b.lo && a.run < b.run);
 }
 
+/* tidy: bound=runs_keys:k,runs_vals:k,ns:k,seg_ends:nseg,seg_words:nseg,seg_masks:nseg — the run and segment descriptor arrays are caller-sized to exactly k and nseg; keys_out/vals_out are sized to the total row count (caller contract, lsm/store.py) */
 int hostops_merge_kv_bloom(
     int64_t k, const uint64_t **runs_keys, const uint32_t **runs_vals,
     const int64_t *ns, uint64_t *keys_out, uint32_t *vals_out,
@@ -363,7 +364,7 @@ int hostops_merge_kv_bloom(
         idx[r] = 0;
         if (ns[r] <= 0) continue;
         merge_head h = { runs_keys[r][1], r };
-        int64_t i = hn++;
+        int64_t i = hn++; /* tidy: range=i:0..63,hn:1..64 — one push per run, and k <= 64 was checked above */
         while (i > 0) { /* sift up */
             int64_t p = (i - 1) >> 1;
             if (!head_lt(h, heap[p])) break;
@@ -373,8 +374,8 @@ int hostops_merge_kv_bloom(
         heap[i] = h;
     }
     int64_t out = 0;
-    while (hn > 0) {
-        int64_t r = heap[0].run;
+    while (hn > 0) { /* tidy: range=hn:0..64 — pops never outnumber the k <= 64 pushes */
+        int64_t r = heap[0].run; /* tidy: range=r:0..<k — heap entries carry run indices in [0, k) */
         int64_t j = idx[r];
         int64_t end = ns[r];
         if (hn == 1) {
@@ -467,6 +468,7 @@ int hostops_merge_kv(
  * then binary search inside the located block — O(log gap) instead of
  * O(log n), which is what makes probing a long run with a short sorted
  * candidate list cheap (scan_merge.zig's probe(), re-shaped for arrays). */
+/* tidy: range=lo:0..0xffffffff,n:0..0xffffffff; bound=a:n — callers pass segment row counts (< 4G rows per table) */
 static inline int64_t gallop_lower_u32(
     const uint32_t *a, int64_t lo, int64_t n, uint32_t key
 ) {
@@ -480,7 +482,7 @@ static inline int64_t gallop_lower_u32(
     /* invariant: a[lo-1] < key (or lo at start), a[hi] >= key (or hi==n) */
     while (lo < hi) {
         int64_t mid = lo + ((hi - lo) >> 1);
-        if (a[mid] < key) lo = mid + 1; else hi = mid;
+        if (a[mid] < key) lo = mid + 1; else hi = mid; /* tidy: allow=c-index-bound — lo <= mid < hi <= n by the gallop cap above; the lo < hi relation is outside the interval domain */
     }
     return lo;
 }
@@ -489,6 +491,7 @@ static inline int64_t gallop_lower_u32(
  * output is the unique common values, ascending). Gallops on whichever
  * side is ahead, so cost is O(min(na, nb) * log(gap)) — the short side
  * drives. Returns the output count (out must hold min(na, nb)). */
+/* tidy: range=na:0..0xffffffff,nb:0..0xffffffff; bound=a:na,b:nb — out is sized min(na, nb) by the caller (lsm/scan.py), a relational contract the write below documents */
 int64_t hostops_intersect_u32(
     int64_t na, const uint32_t *a, int64_t nb, const uint32_t *b,
     uint32_t *out
@@ -501,9 +504,9 @@ int64_t hostops_intersect_u32(
             while (i < na && a[i] == va) i++;
             while (j < nb && b[j] == vb) j++;
         } else if (va < vb) {
-            i = gallop_lower_u32(a, i + 1, na, vb);
+            i = gallop_lower_u32(a, i + 1, na, vb); /* tidy: range=i:0..0xffffffff — gallop returns an index in [lo, n] */
         } else {
-            j = gallop_lower_u32(b, j + 1, nb, va);
+            j = gallop_lower_u32(b, j + 1, nb, va); /* tidy: range=j:0..0xffffffff — gallop returns an index in [lo, n] */
         }
     }
     return k;
@@ -514,6 +517,7 @@ int64_t hostops_intersect_u32(
  * caller ORs one probe per fence-selected segment, then compresses).
  * Returns the number of NEWLY set marks so the caller can stop probing
  * further segments once every candidate is accounted for. */
+/* tidy: range=nc:0..0xffffffff,ns:0..0xffffffff; bound=cand:nc,hit:nc,seg:ns — candidate/hit arrays share length nc; seg is one table segment */
 int64_t hostops_gallop_mark_u32(
     int64_t nc, const uint32_t *cand, int64_t ns, const uint32_t *seg,
     uint8_t *hit
@@ -522,7 +526,7 @@ int64_t hostops_gallop_mark_u32(
     for (int64_t i = 0; i < nc; i++) {
         if (hit[i]) continue;
         uint32_t c = cand[i];
-        j = gallop_lower_u32(seg, j, ns, c);
+        j = gallop_lower_u32(seg, j, ns, c); /* tidy: range=j:0..0xffffffff — gallop returns an index in [lo, n] */
         if (j >= ns) break;
         if (seg[j] == c) {
             hit[i] = 1;

@@ -1,0 +1,17 @@
+"""Seeded ctypes ABI violations against abi_shim.c: a narrowed scalar
+arg, a dropped parameter, a void return left on the implicit c_int
+default, and a declaration for a symbol no C source exports."""
+
+import ctypes
+
+
+def fx(lib_path):
+    lib = ctypes.CDLL(lib_path)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.fx_sum.argtypes = [u32p, ctypes.c_int32]
+    lib.fx_sum.restype = ctypes.c_int64
+    lib.fx_fill.argtypes = [u64p, ctypes.c_int64]
+    lib.fx_missing.argtypes = [ctypes.c_int64]
+    lib.fx_missing.restype = None
+    return lib

@@ -1,0 +1,17 @@
+"""The clean inverse of abi_bad.py: every declaration agrees with the
+abi_shim.c prototypes and every export is wrapped."""
+
+import ctypes
+
+
+def fx(lib_path):
+    lib = ctypes.CDLL(lib_path)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.fx_sum.argtypes = [u32p, ctypes.c_int64]
+    lib.fx_sum.restype = ctypes.c_int64
+    lib.fx_fill.argtypes = [u64p, ctypes.c_int64, ctypes.c_uint32]
+    lib.fx_fill.restype = None
+    lib.fx_unwrapped.argtypes = []
+    lib.fx_unwrapped.restype = ctypes.c_int32
+    return lib

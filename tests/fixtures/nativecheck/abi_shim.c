@@ -1,0 +1,21 @@
+/* Fixture: the C side of the ABI pair — three exports the abi_bad.py /
+ * abi_clean.py declarations are checked against. */
+#include <stdint.h>
+
+int64_t fx_sum(const uint32_t *a, int64_t n) {
+    int64_t s = 0;
+    for (int64_t i = 0; i < n; i++) {
+        s += a[i];
+    }
+    return s;
+}
+
+void fx_fill(uint64_t *out, int64_t n, uint32_t seed) {
+    for (int64_t i = 0; i < n; i++) {
+        out[i] = seed + (uint64_t)i;
+    }
+}
+
+int fx_unwrapped(void) {
+    return 7;
+}

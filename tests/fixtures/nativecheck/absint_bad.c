@@ -1,0 +1,10 @@
+/* Fixture: a seeded out-of-bounds loop — the `<=` bound lets the index
+ * reach the declared array size. */
+#include <stdint.h>
+
+/* tidy: range=n:0..100; bound=a:100 — fixture: callers size a at 100 */
+void fx_oob(int64_t n, int64_t *a) {
+    for (int64_t i = 0; i <= n; i++) {
+        a[i] = i;
+    }
+}

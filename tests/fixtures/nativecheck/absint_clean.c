@@ -1,0 +1,10 @@
+/* Fixture: the clean inverse of absint_bad.c — the strict `<` bound
+ * keeps every subscript inside the declared array size. */
+#include <stdint.h>
+
+/* tidy: range=n:0..100; bound=a:100 — fixture: callers size a at 100 */
+void fx_inbounds(int64_t n, int64_t *a) {
+    for (int64_t i = 0; i < n; i++) {
+        a[i] = i;
+    }
+}

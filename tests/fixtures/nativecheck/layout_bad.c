@@ -1,0 +1,15 @@
+/* Fixture: seeded layout violations against the test's expectation
+ * table — two shifted defines, one deleted, one unknown wire-prefixed
+ * define with no parity entry. */
+#include <stdint.h>
+
+#define OFF_CHECKSUM 0
+#define OFF_SIZE 84
+#define HEADER_SIZE 255
+#define T_LEDGER 52
+#define OFF_MYSTERY 12
+
+uint64_t fx_layout_probe(const uint8_t *frame) {
+    return (uint64_t)frame[OFF_CHECKSUM] + frame[OFF_SIZE]
+         + frame[T_LEDGER] + frame[OFF_MYSTERY] + HEADER_SIZE;
+}

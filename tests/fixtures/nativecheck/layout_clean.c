@@ -1,0 +1,14 @@
+/* Fixture: every wire-layout define agrees with the test's expectation
+ * table (the clean inverse of layout_bad.c). */
+#include <stdint.h>
+
+#define OFF_CHECKSUM 0
+#define OFF_SIZE 80
+#define HEADER_SIZE 256
+#define T_LEDGER 52
+#define OFF_GONE 10
+
+uint64_t fx_layout_probe(const uint8_t *frame) {
+    return (uint64_t)frame[OFF_CHECKSUM] + frame[OFF_SIZE]
+         + frame[T_LEDGER] + frame[OFF_GONE] + HEADER_SIZE;
+}

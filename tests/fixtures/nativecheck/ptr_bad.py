@@ -1,0 +1,12 @@
+"""Seeded pointer-lifetime misuse: raw addresses of array temporaries."""
+
+import numpy as np
+
+
+def bad_capture():
+    addr = np.zeros(16, dtype=np.uint64).ctypes.data
+    return addr
+
+
+def bad_return(rows):
+    return np.ascontiguousarray(rows).ctypes.data

@@ -1,0 +1,15 @@
+"""The clean inverse of ptr_bad.py: addresses taken from named arrays
+whose binding outlives the pointer, plus one annotated waiver."""
+
+import numpy as np
+
+
+def ok_named(rows):
+    a = np.ascontiguousarray(rows)
+    addr = a.ctypes.data
+    return addr, a
+
+
+def ok_allowed():
+    addr = np.zeros(4).ctypes.data  # tidy: allow=ptr-lifetime — fixture: the address is compared, never dereferenced
+    return addr

@@ -59,6 +59,7 @@ def test_check_tool_json_runs_clean():
     assert set(report["passes"]) == {
         "ownership", "determinism", "markers",
         "host-sync", "retrace", "reduction", "absint",
+        "native-layout", "native-abi", "native-absint",
     }
     assert report["suppressed"] == []  # empty baseline: nothing suppressed
 

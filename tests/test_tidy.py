@@ -62,6 +62,7 @@ def test_cli_json_mode():
     assert set(report["passes"]) == {
         "ownership", "determinism", "markers",
         "host-sync", "retrace", "reduction", "absint",
+        "native-layout", "native-abi", "native-absint",
     }
     assert isinstance(report["findings"], list)
 

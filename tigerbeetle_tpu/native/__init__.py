@@ -18,9 +18,10 @@ reference's fixed AEGIS choice).
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
-from typing import Callable, Optional
+from typing import Callable, Optional, Tuple
 
 _CSRC = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
@@ -32,29 +33,75 @@ _LIB = os.path.join(_CSRC, "libaegis128l.so")
 _mac: Optional[Callable[[bytes], bytes]] = None
 _tried = False
 
+# Baseline flag set for every shim build. The warning set is part of the
+# contract: the sources compile warning-free, and tools/nativecheck.py
+# --strict-warnings turns any regression into a finding.
+_BASE_FLAGS = ("-O3", "-Wall", "-Wextra")
 
-def _build_lib(src: str, lib: str, extra_flags: tuple = ()) -> bool:
-    """Compile `src` → shared object `lib` if stale; True on success."""
-    if os.path.exists(lib) and os.path.getmtime(lib) >= os.path.getmtime(src):
-        return True
+# Extra flags injected by tooling (the sanitizer replay harness sets
+# "-fsanitize=address,undefined -g -O1" here). Non-empty values route the
+# build into a flag-hashed SIDECAR .so, so an instrumented build can never
+# be mistaken for — or clobber — the production library.
+_FLAGS_ENV = "TIGERBEETLE_TPU_NATIVE_CFLAGS"
+
+
+def _env_flags() -> Tuple[str, ...]:
+    v = os.environ.get(_FLAGS_ENV, "")
+    return tuple(v.split()) if v else ()
+
+
+def _flags_hash(flags: Tuple[str, ...]) -> str:
+    return hashlib.sha256(" ".join(flags).encode()).hexdigest()[:12]
+
+
+def _build_lib(src: str, lib: str, extra_flags: tuple = ()) -> Optional[str]:
+    """Compile `src` → a shared object; returns the built path or None.
+
+    Staleness keys on BOTH the source mtime and a hash of the full flag
+    set (sidecar stamp `<lib>.flags`): changing flags rebuilds even when
+    the source did not change, and a .so produced under different flags
+    is never trusted. With _FLAGS_ENV set the output itself moves to a
+    flag-hashed sidecar name beside the production library.
+    """
+    flags = (*_BASE_FLAGS, *extra_flags, *_env_flags())
+    fh = _flags_hash(flags)
+    if _env_flags():
+        base, ext = os.path.splitext(lib)
+        lib = f"{base}.{fh}{ext}"
+    stamp = f"{lib}.flags"
+    try:
+        with open(stamp) as f:
+            stamp_ok = f.read().strip() == fh
+    except OSError:
+        stamp_ok = False
+    if (stamp_ok and os.path.exists(lib)
+            and os.path.getmtime(lib) >= os.path.getmtime(src)):
+        return lib
     tmp = f"{lib}.{os.getpid()}.tmp"  # pid-unique: concurrent first builds
     # must not interleave into one output (os.replace is atomic)
     for cc in ("cc", "gcc", "clang"):
         try:
             r = subprocess.run(
-                [cc, "-O3", *extra_flags, "-shared", "-fPIC", src, "-o", tmp],
-                capture_output=True, timeout=60,
+                [cc, *flags, "-shared", "-fPIC", src, "-o", tmp],
+                capture_output=True, timeout=120,
             )
         except (OSError, subprocess.TimeoutExpired):
             continue
         if r.returncode == 0:
             os.replace(tmp, lib)
-            return True
+            stamp_tmp = f"{stamp}.{os.getpid()}.tmp"
+            try:
+                with open(stamp_tmp, "w") as f:
+                    f.write(fh)
+                os.replace(stamp_tmp, stamp)
+            except OSError:
+                pass  # stampless: next import just rebuilds
+            return lib
     try:
         os.unlink(tmp)
     except OSError:
         pass
-    return False
+    return None
 
 
 _hostops: Optional[ctypes.CDLL] = None
@@ -69,8 +116,10 @@ def hostops() -> Optional[ctypes.CDLL]:
         return _hostops
     _hostops_tried = True
     src = os.path.join(_CSRC, "hostops.c")
-    lib_path = os.path.join(_CSRC, "libhostops.so")
-    if not os.path.exists(src) or not _build_lib(src, lib_path):
+    if not os.path.exists(src):
+        return None
+    lib_path = _build_lib(src, os.path.join(_CSRC, "libhostops.so"))
+    if lib_path is None:
         return None
     try:
         lib = ctypes.CDLL(lib_path)
@@ -83,14 +132,17 @@ def hostops() -> Optional[ctypes.CDLL]:
     lib.hostops_map_new.argtypes = [ctypes.c_uint64]
     lib.hostops_map_new.restype = ctypes.c_void_p
     lib.hostops_map_free.argtypes = [ctypes.c_void_p]
+    lib.hostops_map_free.restype = None
     lib.hostops_map_len.argtypes = [ctypes.c_void_p]
     lib.hostops_map_len.restype = ctypes.c_uint64
     lib.hostops_map_insert_batch.argtypes = [
         ctypes.c_void_p, ctypes.c_int64, u64p, u64p, u32p,
     ]
+    lib.hostops_map_insert_batch.restype = None
     lib.hostops_map_lookup_batch.argtypes = [
         ctypes.c_void_p, ctypes.c_int64, u64p, u64p, u32p,
     ]
+    lib.hostops_map_lookup_batch.restype = None
     lib.hostops_map_contains_any.argtypes = [
         ctypes.c_void_p, ctypes.c_int64, u64p, u64p,
     ]
@@ -102,9 +154,11 @@ def hostops() -> Optional[ctypes.CDLL]:
     lib.hostops_bloom_add.argtypes = [
         u64p, ctypes.c_uint64, ctypes.c_int64, u64p, u64p,
     ]
+    lib.hostops_bloom_add.restype = None
     lib.hostops_bloom_maybe.argtypes = [
         u64p, ctypes.c_uint64, ctypes.c_int64, u64p, u64p, u8p,
     ]
+    lib.hostops_bloom_maybe.restype = None
     lib.hostops_post_u128.argtypes = [
         u32p, u32p, u32p, u32p, ctypes.c_int64,
         i64p, i64p, u64p, u64p, u8p, u8p,
@@ -206,8 +260,13 @@ def _cpu_has_aes() -> bool:
         return False
 
 
+_lib_built: Optional[str] = None  # actual aegis .so path (variant-aware)
+
+
 def _build() -> bool:
-    return _build_lib(_SRC, _LIB, extra_flags=("-maes", "-mssse3"))
+    global _lib_built
+    _lib_built = _build_lib(_SRC, _LIB, extra_flags=("-maes", "-mssse3"))
+    return _lib_built is not None
 
 
 def aegis128l_mac() -> Optional[Callable[[bytes], bytes]]:
@@ -221,7 +280,7 @@ def aegis128l_mac() -> Optional[Callable[[bytes], bytes]]:
     if not _build():
         return None
     try:
-        lib = ctypes.CDLL(_LIB)
+        lib = ctypes.CDLL(_lib_built or _LIB)
     except OSError:
         return None
     fn = lib.aegis128l_mac
@@ -257,10 +316,13 @@ def busio() -> Optional[ctypes.CDLL]:
     if not _cpu_has_aes():
         return None
     src = os.path.join(_CSRC, "busio.c")
-    lib_path = os.path.join(_CSRC, "libbusio.so")
-    if not os.path.exists(src) or not _build_lib(
-        src, lib_path, extra_flags=("-maes", "-mssse3")
-    ):
+    if not os.path.exists(src):
+        return None
+    lib_path = _build_lib(
+        src, os.path.join(_CSRC, "libbusio.so"),
+        extra_flags=("-maes", "-mssse3"),
+    )
+    if lib_path is None:
         return None
     try:
         lib = ctypes.CDLL(lib_path)
@@ -276,6 +338,7 @@ def busio() -> Optional[ctypes.CDLL]:
         ctypes.c_char_p, ctypes.c_uint64, u64p, ctypes.c_int64, u64p,
     ]
     lib.busio_scan.restype = ctypes.c_int64
+    # tidy: allow=abi-type — arg 3 (const uint64_t *p) takes codec._ENC_PARAMS.pack's 14-word bytes block; c_char_p marshals it in one conversion instead of 14 scalar casts
     lib.busio_encode_frame.argtypes = [
         u8p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
     ]
@@ -310,21 +373,26 @@ def tb_client() -> Optional[ctypes.CDLL]:
     if not _cpu_has_aes():
         return None
     src = os.path.join(_CSRC, "tb_client.c")
-    lib_path = os.path.join(_CSRC, "libtbclient.so")
-    if not os.path.exists(src) or not _build_lib(
-        src, lib_path, extra_flags=("-maes", "-mssse3")
-    ):
+    if not os.path.exists(src):
+        return None
+    lib_path = _build_lib(
+        src, os.path.join(_CSRC, "libtbclient.so"),
+        extra_flags=("-maes", "-mssse3"),
+    )
+    if lib_path is None:
         return None
     try:
         lib = ctypes.CDLL(lib_path)
     except OSError:
         return None
     u8p = ctypes.POINTER(ctypes.c_uint8)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
     lib.tbc_connect.argtypes = [
         ctypes.c_char_p, ctypes.c_uint16, ctypes.c_uint64, ctypes.c_uint32,
     ]
     lib.tbc_connect.restype = ctypes.c_void_p
     lib.tbc_close.argtypes = [ctypes.c_void_p]
+    lib.tbc_close.restype = None
     for fn in (
         lib.tbc_create_accounts, lib.tbc_create_transfers,
         lib.tbc_lookup_accounts, lib.tbc_lookup_transfers,
@@ -333,6 +401,10 @@ def tb_client() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, u8p, ctypes.c_uint32, u8p, ctypes.c_uint32,
         ]
         fn.restype = ctypes.c_int64
+    lib.tbc_demux_results.argtypes = [
+        u8p, ctypes.c_uint32, u32p, ctypes.c_uint32, u32p, u32p,
+    ]
+    lib.tbc_demux_results.restype = ctypes.c_int
     _tbclient = lib
     return _tbclient
 
@@ -342,7 +414,7 @@ def aegis128l_mac_ptr() -> Optional[Callable[[int, int], bytes]]:
     sibling of aegis128l_mac for numpy-array bodies."""
     if aegis128l_mac() is None:
         return None
-    lib = ctypes.CDLL(_LIB)
+    lib = ctypes.CDLL(_lib_built or _LIB)
     fn = lib.aegis128l_mac
     fn.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p]
     fn.restype = None

@@ -34,6 +34,15 @@ comments. Three passes turn them into checked invariants:
     the u128 limb arithmetic and the fold56 key build: every + - * <<
     is proven to stay within the limb width from `# tidy: range=`
     entry annotations, or flagged.
+  - `native-layout` / `native-abi` / `native-absint`
+    (tidy/nativecheck.py, C front end in tidy/cparse.py) — the
+    C-boundary domain: wire-layout `#define`s in csrc/ proven equal to
+    the authoritative Python dtypes, every ctypes argtypes/restype
+    checked against the parsed C prototypes (plus a `.ctypes.data`
+    pointer-lifetime lint), and the interval interpreter extended to
+    the C scan/gallop/heap loops via `/* tidy: range=/bound= */`
+    annotations. The dynamic leg is tools/nativecheck.py --sanitize
+    (ASan+UBSan sidecar builds replaying the golden/fuzz corpora).
 
 Findings are suppressed either inline (`# tidy: allow=<code> <reason>`)
 or via the checked-in baseline (baseline.json) so existing intentional
@@ -62,6 +71,7 @@ def all_pass_names():
     return (
         "ownership", "determinism", "markers",
         "host-sync", "retrace", "reduction", "absint",
+        "native-layout", "native-abi", "native-absint",
     )
 
 
@@ -72,7 +82,7 @@ def run_passes(root=None, passes=None):
     import pathlib
 
     from tigerbeetle_tpu.tidy import (
-        absint, determinism, jaxlint, markers, ownership,
+        absint, determinism, jaxlint, markers, nativecheck, ownership,
     )
 
     if root is None:
@@ -83,8 +93,17 @@ def run_passes(root=None, passes=None):
         "determinism": determinism.run,
         "markers": markers.run,
         "absint": absint.run,
+        "native-layout": nativecheck.run_layout,
+        "native-abi": nativecheck.run_abi,
+        "native-absint": nativecheck.run_absint,
     }
     selected = passes if passes is not None else list(all_pass_names())
+    # `native` expands to the whole C-boundary domain (check.py --passes
+    # native runs all three, mirroring how the jaxlint trio groups).
+    if "native" in selected:
+        selected = [p for p in selected if p != "native"] + [
+            "native-layout", "native-abi", "native-absint",
+        ]
     unknown = [p for p in selected if p not in all_pass_names()]
     if unknown:
         # A typo must never silently disable a pass (the same rule the

@@ -162,6 +162,46 @@ ABSINT_TARGETS = {
     "tigerbeetle_tpu/ops/qindex.py": 32,
 }
 
+# --- nativecheck: C-boundary analysis scope ------------------------------
+
+# Every C-family file under csrc/ must either be scanned (layout parity +
+# ctypes ABI + prototype extraction) or carry an explicit exclusion with
+# its reason here — the pass asserts the scanned set equals the csrc/
+# glob minus these, so a new C file cannot ride in unanalyzed.
+NATIVE_C_SOURCES = (
+    "csrc/busio.c",
+    "csrc/hostops.c",
+    "csrc/aegis128l.c",
+    "csrc/tb_client.c",
+    "csrc/tb_client.h",
+)
+NATIVE_C_EXCLUDE = {
+    "csrc/cpp_sample.cpp":
+        "C++17 embedder sample (templates/RAII outside cparse's C "
+        "subset); compiled and exercised end-to-end by "
+        "tests/test_cpp_client.py, exposes no ctypes surface",
+    "csrc/tb_client.hpp":
+        "header-only C++ wrapper over tb_client.h; the C ABI underneath "
+        "is the scanned contract (tb_client.h), the wrapper is covered "
+        "by tests/test_cpp_client.py",
+}
+
+# (repo-relative C file, function) pairs the C bounds-absint interprets.
+# Each carries a `/* tidy: range=/bound= */` entry annotation in source;
+# a listed function that fails to parse or goes missing is a finding
+# (c-parse), never a silent skip.
+NATIVE_ABSINT_FUNCS = (
+    ("csrc/busio.c", "busio_scan"),
+    ("csrc/hostops.c", "gallop_lower_u32"),
+    ("csrc/hostops.c", "hostops_intersect_u32"),
+    ("csrc/hostops.c", "hostops_gallop_mark_u32"),
+    ("csrc/hostops.c", "hostops_merge_kv_bloom"),
+)
+
+# Directories the pointer-lifetime lint walks for `.ctypes.data` captures
+# (native call sites live in the package and the tools).
+NATIVE_LIFETIME_SCAN_DIRS = ("tigerbeetle_tpu", "tools")
+
 # --- marker scan scope ---------------------------------------------------
 
 # Directories / top-level scripts covered by the banned-marker scan.

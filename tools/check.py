@@ -2,8 +2,11 @@
 """Single static-analysis entry: every tidy pass, one report, one baseline.
 
 Runs the full analyzer suite — ownership/lockset, determinism lint,
-marker scan, and the device hot-path passes (host-sync, retrace,
-reduction, absint) — against the repo and gates on the shared baseline
+marker scan, the device hot-path passes (host-sync, retrace, reduction,
+absint), and the C-boundary domain (native-layout, native-abi,
+native-absint; `--passes native` selects all three, and the dynamic
+sanitizer leg lives in tools/nativecheck.py) — against the repo and
+gates on the shared baseline
 (tigerbeetle_tpu/tidy/baseline.json), then the devhub pass: the
 perf-trajectory change-point detector (tools/devhub.py, docs/DEVHUB.md)
 over devhub.jsonl. The devhub pass is ADVISORY by default (steps are
@@ -126,8 +129,10 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report on stdout")
     ap.add_argument(
-        "--passes", nargs="+", choices=tuple(_pass_names()),
-        default=None, help="subset of passes (default: all)",
+        "--passes", nargs="+", choices=tuple(_pass_names()) + ("native",),
+        default=None,
+        help="subset of passes (default: all; 'native' expands to "
+             "native-layout native-abi native-absint)",
     )
     ap.add_argument("--baseline", default=None, help="baseline file override")
     ap.add_argument(

@@ -1,0 +1,481 @@
+#!/usr/bin/env python
+"""Dynamic leg of the C-boundary checks: sanitizer replay + warning gate.
+
+The static passes (tools/check.py --passes native; tidy/nativecheck.py)
+prove layout parity, ABI agreement, and in-bounds indexing on the
+abstract side. This tool runs the same C under instrumentation:
+
+  --sanitize         rebuild every shim with ASan+UBSan into flag-hashed
+                     SIDECAR .so files (native._build_lib's _FLAGS_ENV
+                     mechanism — the production libraries are never
+                     touched) and replay the codec golden vectors plus
+                     randomized sort/merge/bloom/intersect corpora under
+                     them in a subprocess. Any sanitizer report or
+                     cross-check mismatch fails.
+  --strict-warnings  compile each manifest-listed C source with the
+                     contract flag set (-Wall -Wextra) and report every
+                     compiler warning as a finding.
+  --full             larger corpora + the >64-run merge fold path (the
+                     `slow`-marked tier; default is the tier-1 smoke).
+  --json             machine-readable report on stdout.
+
+With no mode flag both legs run. A host that cannot build the shims
+(no compiler / no AES-NI) or has no sanitizer runtimes is a benign
+skip — the static passes and the pure-Python fallbacks are the
+contract there — but a host that CAN run the replay and trips a
+sanitizer fails loudly: heap overflow in the merge heap or UB in the
+scan loop is corruption, not a perf knob.
+
+The child mode (--replay) is internal: it runs the corpora in-process
+against the sanitized sidecars and is launched with LD_PRELOAD set to
+the asan/ubsan runtimes so the uninstrumented interpreter can host the
+instrumented libraries.
+
+Rule catalog and workflow: docs/STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+TOOLS = pathlib.Path(__file__).resolve().parent
+REPO = TOOLS.parents[0]
+sys.path.insert(0, str(REPO))
+
+# Flag set injected for sanitized sidecar builds (native._FLAGS_ENV).
+SANITIZE_FLAGS = "-fsanitize=address,undefined -fno-sanitize-recover=all -g -O1"
+
+# Stderr markers that mean a sanitizer fired even if the child somehow
+# kept a zero exit status (belt and braces around halt_on_error).
+_SAN_MARKERS = (
+    "ERROR: AddressSanitizer",
+    "AddressSanitizer:",
+    "runtime error:",
+    "SUMMARY: UndefinedBehaviorSanitizer",
+    "ERROR: LeakSanitizer",
+)
+
+
+def _find_runtime(name: str):
+    """Full path of a sanitizer runtime via the compiler, or None."""
+    for cc in ("gcc", "cc"):
+        try:
+            r = subprocess.run(
+                [cc, f"-print-file-name={name}"],
+                capture_output=True, text=True, timeout=30,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        p = r.stdout.strip()
+        if r.returncode == 0 and p and os.path.sep in p and os.path.exists(p):
+            return p
+    return None
+
+
+# --- --strict-warnings: the compile-warning gate ---------------------------
+
+
+def check_warnings():
+    """Compile each manifest C source with the contract flags; every
+    compiler diagnostic line is a finding. Returns (findings, note) —
+    note is non-None when the gate could not run (no compiler)."""
+    from tigerbeetle_tpu.tidy import manifest
+
+    findings = []
+    ran_any = False
+    for rel in manifest.NATIVE_C_SOURCES:
+        if not rel.endswith(".c"):
+            continue  # headers are compiled as part of their .c
+        src = REPO / rel
+        if not src.exists():
+            continue
+        # The AES shims need the intrinsic sets the runtime builds use;
+        # warning parity only holds under the same target flags.
+        extra = () if rel.endswith("hostops.c") else ("-maes", "-mssse3")
+        for cc in ("cc", "gcc", "clang"):
+            try:
+                r = subprocess.run(
+                    [cc, "-O2", "-Wall", "-Wextra", *extra,
+                     "-fsyntax-only", str(src)],
+                    capture_output=True, text=True, timeout=120,
+                )
+            except (OSError, subprocess.TimeoutExpired):
+                continue
+            ran_any = True
+            for line in r.stderr.splitlines():
+                if "warning:" in line or "error:" in line:
+                    findings.append(f"{rel}: {line.strip()}")
+            break
+    if not ran_any:
+        return [], "no C compiler"
+    return findings, None
+
+
+# --- --replay: the in-process corpora (child mode) -------------------------
+
+
+def _replay_codec(full: bool):
+    """Golden vectors + (full) a randomized frame-stream scan."""
+    import numpy as np
+
+    from tigerbeetle_tpu.net import codec
+
+    if not codec.enabled():
+        return ["skip: codec unavailable"]
+    fails = list(codec.golden_check())
+    if full and not fails:
+        from tigerbeetle_tpu.vsr import header as hdr
+        from tigerbeetle_tpu.vsr.header import Command
+
+        rng = np.random.default_rng(0x5A17)
+        msgs = []
+        for i in range(100):
+            body = rng.bytes(int(rng.integers(0, 4096)))
+            msgs.append(
+                codec.Message(
+                    hdr.make(
+                        Command.REQUEST, 7, client=int(rng.integers(1, 1 << 60)),
+                        op=i + 1, commit=i, request=i, replica=int(i % 6),
+                        operation=int(rng.integers(128, 132)),
+                    ),
+                    body,
+                ).seal()
+            )
+        stream = b"".join(m.to_bytes() for m in msgs)
+        rows, consumed, _need, status = codec._thread_scanner().scan(stream)
+        if (
+            len(rows) != len(msgs) or consumed != len(stream)
+            or status != codec.STATUS_OK
+        ):
+            fails.append(
+                f"stream scan drifted: n={len(rows)}/{len(msgs)} "
+                f"consumed={consumed}/{len(stream)} status={status}"
+            )
+        else:
+            out = codec.messages_from_scan(stream, rows)
+            for m, ref in zip(out, msgs):
+                if m.to_bytes() != ref.to_bytes():
+                    fails.append("scanned frame bytes drifted")
+                    break
+    return fails
+
+
+def _replay_sort_merge(full: bool):
+    """sort_kv + k-way merge (plain and Bloom-fused) vs a pure-numpy
+    reference ordering, through the public store entry points."""
+    import numpy as np
+
+    from tigerbeetle_tpu.lsm import store
+
+    if store._hostops() is None:
+        return ["skip: hostops unavailable"]
+    fails = []
+    rng = np.random.default_rng(0xC0FFEE)
+    n = 200_000 if full else 6_000
+    keys = np.zeros(n, dtype=store.KEY_DTYPE)
+    # A narrow lo range forces heavy duplicate runs — the stability
+    # contract (ties keep insertion order) is where sort bugs hide.
+    keys["lo"] = rng.integers(0, n // 4, n, dtype=np.uint64)
+    keys["hi"] = rng.integers(0, 1 << 63, n, dtype=np.uint64)
+    vals = np.arange(n, dtype=np.uint32)
+    ref_order = np.argsort(keys["lo"], kind="stable")
+    sk, sv = store.sort_kv(keys, vals)
+    if not (np.array_equal(sk, keys[ref_order])
+            and np.array_equal(sv, vals[ref_order])):
+        fails.append("sort_kv drifted from the stable numpy reference")
+
+    for k in ((2, 7, 64, 130) if full else (2, 7, 64)):
+        owner = rng.integers(0, k, n)
+        parts_k, parts_v = [], []
+        for g in range(k):
+            gk, gv = keys[owner == g], vals[owner == g]
+            order = np.argsort(gk["lo"], kind="stable")
+            parts_k.append(gk[order])
+            parts_v.append(gv[order])
+        cat_k = np.concatenate(parts_k)
+        cat_v = np.concatenate(parts_v)
+        ref = np.argsort(cat_k["lo"], kind="stable")
+        mk, mv = store.merge_host_kway(parts_k, parts_v)
+        if not (np.array_equal(mk, cat_k[ref])
+                and np.array_equal(mv, cat_v[ref])):
+            fails.append(f"merge_host_kway drifted at k={k}")
+
+        # Bloom-fused variant: same rows, plus per-segment filter bits
+        # identical to adding the finished output slices.
+        nseg = 4
+        seg_ends = [((s + 1) * n) // nseg for s in range(nseg)]
+        blooms = [store.Bloom(n // nseg) for _ in range(nseg - 1)] + [None]
+        bk, bv = store.merge_host_kway_bloom(parts_k, parts_v, seg_ends, blooms)
+        if not (np.array_equal(bk, mk) and np.array_equal(bv, mv)):
+            fails.append(f"merge_host_kway_bloom rows drifted at k={k}")
+            continue
+        start = 0
+        for end, bloom in zip(seg_ends, blooms):
+            if bloom is not None and end > start:
+                seg = bk[start:end]
+                ref_words = _py_bloom_words(
+                    bloom, seg["lo"], seg["hi"]
+                )
+                if not np.array_equal(bloom.words, ref_words):
+                    fails.append(
+                        f"fused Bloom bits drifted at k={k} seg_end={end}"
+                    )
+            start = end
+    return fails
+
+
+def _py_bloom_words(bloom, lo, hi):
+    """Pure-python reference of Bloom.add's bit pattern (the C fallback
+    branch, computed independently of the shim)."""
+    import numpy as np
+
+    words = np.zeros_like(bloom.words)
+    h1, h2 = type(bloom)._hash2(
+        np.asarray(lo, dtype=np.uint64), np.asarray(hi, dtype=np.uint64)
+    )
+    for h in (h1, h2):
+        b = h & bloom._mask
+        np.bitwise_or.at(
+            words, (b >> np.uint64(6)).astype(np.int64),
+            np.uint64(1) << (b & np.uint64(63)),
+        )
+    return words
+
+
+def _replay_bloom(full: bool):
+    """hostops_bloom_add / _maybe vs the pure-python hash: identical
+    bits, no false negatives."""
+    import numpy as np
+
+    from tigerbeetle_tpu.lsm import store
+
+    if store._hostops() is None:
+        return ["skip: hostops unavailable"]
+    fails = []
+    rng = np.random.default_rng(0xB100)
+    n = 100_000 if full else 4_000
+    lo = rng.integers(0, 1 << 63, n, dtype=np.uint64)
+    hi = rng.integers(0, 1 << 63, n, dtype=np.uint64)
+    bloom = store.Bloom(n)
+    bloom.add(lo, hi)  # n > 64: C path
+    if not np.array_equal(bloom.words, _py_bloom_words(bloom, lo, hi)):
+        fails.append("bloom_add bits drifted from the python hash")
+    if not bloom.maybe(lo, hi).all():  # C path again
+        fails.append("bloom false negative (impossible by construction)")
+    other_lo = rng.integers(0, 1 << 63, n, dtype=np.uint64)
+    fp = float(bloom.maybe(other_lo, hi).mean())
+    if fp > 0.5:
+        fails.append(f"bloom false-positive rate implausible ({fp:.2f})")
+    return fails
+
+
+def _replay_intersect(full: bool):
+    """Galloping intersect + gallop-mark vs numpy set ops."""
+    import numpy as np
+
+    from tigerbeetle_tpu.lsm import store
+
+    if store._hostops() is None:
+        return ["skip: hostops unavailable"]
+    fails = []
+    rng = np.random.default_rng(0x6A110)
+    rounds = 40 if full else 8
+    for _ in range(rounds):
+        na = int(rng.integers(33, 50_000 if full else 5_000))
+        nb = int(rng.integers(33, 50_000 if full else 5_000))
+        hi = int(rng.integers(64, 1 << 20))
+        a = np.unique(rng.integers(0, hi, na, dtype=np.uint32))
+        b = np.unique(rng.integers(0, hi, nb, dtype=np.uint32))
+        got = store.intersect_sorted_u32(a, b)
+        ref = np.intersect1d(a, b).astype(np.uint32)
+        if not np.array_equal(got, ref):
+            fails.append(f"intersect drifted (na={len(a)} nb={len(b)})")
+            break
+        cand = np.unique(rng.integers(0, hi, max(na // 4, 8), dtype=np.uint32))
+        hit = np.zeros(len(cand), dtype=np.uint8)
+        fresh = store.gallop_mark_u32(cand, b, hit)
+        ref_hit = np.isin(cand, b)
+        if fresh != int(ref_hit.sum()) or not np.array_equal(
+            hit.view(bool), ref_hit
+        ):
+            fails.append(f"gallop_mark drifted (nc={len(cand)} ns={len(b)})")
+            break
+    return fails
+
+
+def _replay_hashmap(full: bool):
+    """u128 map insert/lookup/contains + duplicate scan through the
+    index wrapper store.make_u128_index builds on."""
+    import numpy as np
+
+    from tigerbeetle_tpu.lsm import store
+
+    if store._hostops() is None:
+        return ["skip: hostops unavailable"]
+    fails = []
+    rng = np.random.default_rng(0x4A5)
+    n = 50_000 if full else 3_000
+    idx = store.make_u128_index(n)
+    keys = np.zeros(n, dtype=store.KEY_DTYPE)
+    # Distinct lo values make every key unique (lookup is unambiguous).
+    keys["lo"] = rng.permutation(n).astype(np.uint64) + np.uint64(1)
+    keys["hi"] = rng.integers(0, 1 << 62, n, dtype=np.uint64)
+    vals = np.arange(n, dtype=np.uint32)
+    idx.insert_batch(keys, vals)
+    got = idx.lookup_batch(keys)
+    if not np.array_equal(got, vals):
+        fails.append("u128 index lookup drifted after insert")
+    missing = keys.copy()
+    missing["lo"] += np.uint64(n + 1)  # disjoint lo range: never inserted
+    if idx.contains_any(missing):
+        fails.append("contains_any claims keys that were never inserted")
+    return fails
+
+
+def run_replay(full: bool):
+    """Child entry: run every corpus, print one line each, exit code =
+    number of failing corpora."""
+    legs = (
+        ("codec", _replay_codec),
+        ("sort-merge", _replay_sort_merge),
+        ("bloom", _replay_bloom),
+        ("intersect", _replay_intersect),
+        ("hashmap", _replay_hashmap),
+    )
+    bad = 0
+    for name, fn in legs:
+        try:
+            fails = fn(full)
+        except Exception as e:  # noqa: BLE001 — a crash IS the finding
+            fails = [f"corpus crashed: {type(e).__name__}: {e}"]
+        if fails and all(f.startswith("skip:") for f in fails):
+            print(f"replay {name}: {fails[0]}")
+            continue
+        if fails:
+            bad += 1
+            for f in fails:
+                print(f"replay {name}: FAIL {f}")
+        else:
+            print(f"replay {name}: ok")
+    print("REPLAY OK" if bad == 0 else f"REPLAY FAIL {bad}")
+    return 0 if bad == 0 else 1
+
+
+# --- --sanitize: the parent harness ----------------------------------------
+
+
+def run_sanitize(full: bool = False, timeout: int = 900):
+    """Launch the replay child against ASan+UBSan sidecar builds.
+
+    Returns {ran, failures, note, output}. Skips (ran=False, no
+    failures) when the host has no sanitizer runtimes — the replay
+    needs LD_PRELOAD of the matching libasan/libubsan so the plain
+    interpreter can host instrumented .so files.
+    """
+    asan = _find_runtime("libasan.so")
+    ubsan = _find_runtime("libubsan.so")
+    if asan is None or ubsan is None:
+        return {"ran": False, "failures": [],
+                "note": "sanitizer runtimes unavailable", "output": ""}
+    from tigerbeetle_tpu import native
+
+    env = dict(os.environ)
+    env[native._FLAGS_ENV] = SANITIZE_FLAGS
+    env["LD_PRELOAD"] = f"{asan} {ubsan}"
+    # The interpreter itself is uninstrumented, so leak accounting is
+    # meaningless noise; every real memory error still reports.
+    env["ASAN_OPTIONS"] = "detect_leaks=0:abort_on_error=0:exitcode=97"
+    env["UBSAN_OPTIONS"] = "print_stacktrace=1:halt_on_error=1"
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [sys.executable, str(TOOLS / "nativecheck.py"), "--replay"]
+    if full:
+        cmd.append("--full")
+    try:
+        r = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout,
+            cwd=str(REPO), env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return {"ran": True, "output": "",
+                "failures": [f"replay timed out after {timeout}s"]}
+    output = r.stdout + r.stderr
+    failures = []
+    if r.returncode != 0:
+        failures.append(f"replay exited {r.returncode}")
+    for marker in _SAN_MARKERS:
+        if marker in output:
+            failures.append(f"sanitizer report: {marker!r} in replay output")
+            break
+    if "REPLAY OK" not in r.stdout and not failures:
+        failures.append("replay produced no REPLAY OK line")
+    return {"ran": True, "failures": failures, "output": output}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--sanitize", action="store_true",
+                    help="ASan+UBSan sidecar builds + corpus replay")
+    ap.add_argument("--strict-warnings", action="store_true",
+                    help="compile the manifest C sources; warnings fail")
+    ap.add_argument("--full", action="store_true",
+                    help="large corpora (the slow tier)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report")
+    ap.add_argument("--replay", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--timeout", type=int, default=900,
+                    help="replay subprocess timeout (seconds)")
+    args = ap.parse_args(argv)
+
+    if args.replay:
+        return run_replay(args.full)
+
+    do_sanitize = args.sanitize or not args.strict_warnings
+    do_warnings = args.strict_warnings or not args.sanitize
+    report = {"ok": True}
+    if do_warnings:
+        findings, note = check_warnings()
+        report["warnings"] = {"findings": findings, "note": note}
+        if findings:
+            report["ok"] = False
+    if do_sanitize:
+        san = run_sanitize(args.full, args.timeout)
+        report["sanitize"] = {
+            "ran": san["ran"], "failures": san["failures"],
+            "note": san.get("note"),
+        }
+        if san["failures"]:
+            report["ok"] = False
+            report["sanitize"]["output"] = san.get("output", "")[-8000:]
+
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        if do_warnings:
+            w = report["warnings"]
+            for f in w["findings"]:
+                print(f"warning: {f}")
+            state = (f"skipped ({w['note']})" if w["note"]
+                     else f"{len(w['findings'])} finding(s)")
+            print(f"strict-warnings: {state}")
+        if do_sanitize:
+            s = report["sanitize"]
+            for f in s["failures"]:
+                print(f"sanitize: {f}")
+            if s["failures"]:
+                print(report["sanitize"].get("output", "")[-4000:])
+            state = ("skipped (" + (s.get("note") or "") + ")"
+                     if not s["ran"] else
+                     f"{len(s['failures'])} failure(s)"
+                     f" ({'full' if args.full else 'smoke'} corpora)")
+            print(f"sanitize: {state}")
+        print("nativecheck:", "ok" if report["ok"] else "FAIL")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
