@@ -1,0 +1,25 @@
+"""Planted vsrlint violations (wire-taint + non-monotonic) — the exact-
+findings fixture for tests/test_vsrlint.py. Every handler here breaks
+one rule on purpose; the clean twin is vsr_ok.py."""
+
+
+class BadReplica:
+    def __init__(self):
+        self.view = 0
+        self.commit_min = 0
+        self.op = 0
+
+    def on_start_view(self, msg):
+        h = msg.header
+        # Unvalidated wire view adopted straight into protocol state:
+        # wire-taint AND non-monotonic on the same assignment.
+        self.view = h["view"]
+
+    def on_commit(self, msg):
+        # Header read without alias, still unguarded: wire-taint +
+        # non-monotonic.
+        self.commit_min = msg.header["commit_min"]
+
+    def regress(self):
+        # Plain decrement of a monotone field: non-monotonic.
+        self.op = self.op - 1

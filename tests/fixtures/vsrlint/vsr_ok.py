@@ -1,0 +1,33 @@
+"""Clean twin of vsr_bad.py: the same adoption shapes made legal via
+every sanctioned proof form — dominating guard, clamped max(), nonneg
+increment, and the `monotonic=` annotation. Must produce ZERO findings
+while still exercising a nonzero checked-sink/assignment count (the
+coverage pin in tests/test_vsrlint.py)."""
+
+
+class GoodReplica:
+    def __init__(self):
+        self.view = 0
+        self.commit_min = 0
+        self.op = 0
+
+    def on_start_view(self, msg):
+        h = msg.header
+        v = h["view"]
+        # Dominating guard: v is compared against the field before the
+        # adoption, which both validates the wire value and proves the
+        # assignment non-decreasing.
+        if v < self.view:
+            return
+        self.view = v
+
+    def on_commit(self, msg):
+        k = msg.header["commit_min"]
+        # Clamped adoption: the guard in value form.
+        self.commit_min = max(self.commit_min, k)
+
+    def bump(self):
+        self.op += 1
+
+    def rebuild(self):
+        self.op = 0  # tidy: monotonic=op — fixture: sanctioned recovery reset

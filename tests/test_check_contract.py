@@ -1,0 +1,84 @@
+"""The tools/check.py automation contract: exit codes (0 clean / 1 new
+findings / 2 usage error), the pinned --json schema (including the
+timing/parallelism keys CI dashboards consume), and the wall-clock
+budget discipline for the parallel pass runner.
+
+tests/test_tidy.py::test_repo_has_no_new_findings gates the repo itself;
+this file gates the ENTRY POINT, so automation wired to its exit codes
+and JSON shape cannot be broken silently.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+CHECK = REPO / "tools" / "check.py"
+
+# A fast but non-trivial subset: the whole VSR domain (AST lints, the
+# exhaustive quorum evaluation, and the bounded model sweep).
+FAST_PASSES = ["vsrlint", "quorum", "protomodel"]
+
+
+def _run(*args, timeout=300):
+    return subprocess.run(
+        [sys.executable, str(CHECK), *args],
+        capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def test_exit_0_and_json_schema_on_clean_subset():
+    proc = _run("--json", "--passes", *FAST_PASSES)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    # The schema is a contract: automation keys off these names.
+    assert set(report) == {
+        "root", "passes", "findings", "new", "suppressed",
+        "stale_baseline_keys", "ok", "timings", "parallel",
+        "devhub", "codec",
+    }
+    assert report["ok"] is True
+    assert report["new"] == []
+    assert report["passes"] == FAST_PASSES
+    # Timings: one entry per work unit, all non-negative wall seconds.
+    assert set(report["timings"]) == set(FAST_PASSES)
+    assert all(
+        isinstance(v, float) and v >= 0 for v in report["timings"].values()
+    )
+    assert report["parallel"] is True
+
+
+def test_exit_1_on_new_finding(tmp_path):
+    """A planted non-monotonic assignment under a --root override must
+    surface as a NEW finding (the shared baseline pins files by path, so
+    a tmp tree can never be silently suppressed) and flip the exit code."""
+    vsr = tmp_path / "tigerbeetle_tpu" / "vsr"
+    vsr.mkdir(parents=True)
+    (vsr / "replica.py").write_text(textwrap.dedent("""\
+        class Replica:
+            def shrink(self):
+                self.view = self.view - 1
+    """))
+    proc = _run(str(tmp_path), "--json", "--passes", "vsrlint")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["ok"] is False
+    assert [(f["pass"], f["code"], f["subject"]) for f in report["new"]] == [
+        ("vsrlint", "non-monotonic", "view"),
+    ]
+
+
+def test_exit_2_on_usage_error():
+    proc = _run("--passes", "no-such-pass")
+    assert proc.returncode == 2
+    assert "invalid choice" in proc.stderr
+
+
+def test_serial_mode_and_timings_report():
+    proc = _run("--serial", "--timings", "--passes", *FAST_PASSES)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "timing" in proc.stdout
+    assert "budget ~60s wall on 2 cores" in proc.stdout
+    assert "(serial;" in proc.stdout

@@ -60,6 +60,7 @@ def test_check_tool_json_runs_clean():
         "ownership", "determinism", "markers",
         "host-sync", "retrace", "reduction", "absint",
         "native-layout", "native-abi", "native-absint",
+        "vsrlint", "quorum", "protomodel",
     }
     assert report["suppressed"] == []  # empty baseline: nothing suppressed
 

@@ -291,3 +291,55 @@ class TestChunkedSync:
         stats = rb.block_sync_stats
         assert stats["missing"] == stats["wanted"] > 0, stats
         cl.check_state_convergence()
+
+
+class TestStaleInstallAbandon:
+    def test_install_abandons_when_drain_overtakes_blob(self):
+        """Regression (found by the vsrlint monotonicity pass):
+        on_sync_checkpoint's freshness guard runs at chunk-assembly time,
+        but _install_sync_checkpoint then calls _quiesce_commit_stage,
+        and the drain applies staged completions that can advance
+        commit_min (even the durable op_checkpoint) PAST the assembled
+        blob. Installing anyway would regress commit_min/checksum_floor
+        and re-point the superblock at an older checkpoint — the install
+        must re-check and abandon after the drain."""
+        from tigerbeetle_tpu import tracer
+
+        cl, bi, c = TestChunkedSync()._lagging_backup_cluster()
+        primary = next(
+            r for r in cl.replicas if r is not None and r.is_primary
+        )
+        entry = primary._sync_blob()
+        assert entry is not None
+        cp_op, blob, _ck = entry
+        cl.restart_replica(bi)
+        rb = cl.replicas[bi]
+        assert rb.commit_min < cp_op  # the arrival-time guard would pass
+
+        orig = rb._quiesce_commit_stage
+
+        def drain_overtakes():
+            orig()
+            # Simulate the race deterministically: the drained stage
+            # carried completions up to (and past) the blob's checkpoint.
+            rb.commit_min = cp_op
+
+        rb._quiesce_commit_stage = drain_overtakes
+        sm_before = rb.state_machine
+        floor_before = rb.checksum_floor
+        ckpt_before = rb.superblock.state.op_checkpoint
+        was = tracer.enabled()
+        tracer.enable()
+        tracer.reset()
+        try:
+            rb._install_sync_checkpoint(cp_op, blob)
+            counts = tracer.snapshot()
+        finally:
+            if not was:
+                tracer.disable()
+        assert counts["recovery.sync_stale_abandon"]["count"] == 1
+        # Nothing was replaced or regressed: same state machine object,
+        # same checksum floor, same durable checkpoint.
+        assert rb.state_machine is sm_before
+        assert rb.checksum_floor == floor_before
+        assert rb.superblock.state.op_checkpoint == ckpt_before

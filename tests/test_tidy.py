@@ -63,8 +63,12 @@ def test_cli_json_mode():
         "ownership", "determinism", "markers",
         "host-sync", "retrace", "reduction", "absint",
         "native-layout", "native-abi", "native-absint",
+        "vsrlint", "quorum", "protomodel",
     }
     assert isinstance(report["findings"], list)
+    # Timing/parallelism contract (the exit-code + schema pins live in
+    # tests/test_check_contract.py; this just keeps the alias honest).
+    assert set(report["timings"]) and isinstance(report["parallel"], bool)
 
 
 # --- ownership pass: fixture with known violations ----------------------

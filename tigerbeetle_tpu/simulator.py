@@ -348,6 +348,44 @@ SMOKE_SEEDS = (0, 1, 2, 5, 9)
 SMOKE_REQUESTS = 12
 SMOKE_BUDGET_S = 120.0
 
+# Model-checker-guided adversarial replay (pass 13, tidy/protomodel,
+# docs/STATIC_ANALYSIS.md): the protocol model checker exports its
+# worst-case abstract interleaving — most distinct commit views, longest
+# committed ledger, primary crash before the first view change — as a
+# replayable fault schedule, and the smoke run replays it on a concrete
+# cluster. The abstract worst case is thereby exercised by LIVE code on
+# every tier-1 run, not only by the abstract checker. The seed must
+# build a 3-replica, no-standby cluster (the model scope).
+ADVERSARIAL_SEED = 9
+
+
+def adversarial_simulator(requests: int = SMOKE_REQUESTS) -> "Simulator":
+    """Simulator for ADVERSARIAL_SEED with its random fault schedule
+    replaced by protomodel.adversarial_schedule(): crash the initial
+    primary's successor pattern from the model trace, partition the old
+    primary at each timeout boundary, heal, restart late. Schedules
+    from other taxonomies (standby promotion, grid corruption, runtime
+    primary-targeting) are cleared so the replay is exactly the model
+    trace's fault pattern."""
+    from tigerbeetle_tpu.tidy import protomodel
+
+    sim = Simulator(ADVERSARIAL_SEED, requests=requests)
+    if sim.replica_count != 3 or sim.standby_count:
+        raise RuntimeError(
+            f"ADVERSARIAL_SEED={ADVERSARIAL_SEED} no longer builds a "
+            "3-replica/no-standby cluster — repick it to match the "
+            "protomodel scope"
+        )
+    sched = protomodel.adversarial_schedule()
+    sim.crash_at = dict(sched["crash_at"])
+    sim.restart_at = dict(sched["restart_at"])
+    sim.partition_at = dict(sched["partition_at"])
+    sim.heal_at = set(sched["heal_at"])
+    sim.crash_primary_at = {}
+    sim.promote_at = {}
+    sim.corrupt_grid_after = None
+    return sim
+
 
 def run_smoke(budget_s: float = SMOKE_BUDGET_S, verbose: bool = False) -> int:
     """Run the fixed smoke seed set under a wall-clock budget."""
@@ -387,8 +425,33 @@ def run_smoke(budget_s: float = SMOKE_BUDGET_S, verbose: bool = False) -> int:
                 f"— the smoke set must stay tier-1-sized", file=sys.stderr,
             )
             return worst if worst != EXIT_PASS else EXIT_LIVENESS
+    # Model-guided adversarial replay, coverage asserted first: a
+    # protomodel scope/scoring change that drops the crash or the
+    # partitions from the exported schedule must fail loudly here, the
+    # same way a tamed SMOKE_SEEDS schedule does above.
+    adv = adversarial_simulator()
+    if not (adv.crash_at and adv.partition_at and adv.heal_at):
+        print(
+            "smoke: protomodel adversarial schedule lost coverage "
+            f"(crash={bool(adv.crash_at)} partition={bool(adv.partition_at)} "
+            f"heal={bool(adv.heal_at)}) — the exported trace no longer "
+            "exercises crash + partition; retune ADVERSARIAL_SCOPE",
+            file=sys.stderr,
+        )
+        return EXIT_LIVENESS
+    try:
+        rc = adv.run()
+    except Exception:  # noqa: BLE001 — VOPR crash taxonomy
+        import traceback
+
+        traceback.print_exc()
+        rc = EXIT_CRASH
+    if rc != EXIT_PASS:
+        print(f"smoke adversarial replay: FAIL exit={rc}", file=sys.stderr)
+        if worst == EXIT_PASS:
+            worst = rc
     print(
-        f"smoke: {len(SMOKE_SEEDS)} seeds in "
+        f"smoke: {len(SMOKE_SEEDS)} seeds + adversarial replay in "
         f"{time.perf_counter() - t0:.1f}s — "
         f"{'PASS' if worst == EXIT_PASS else 'FAIL'}"
     )

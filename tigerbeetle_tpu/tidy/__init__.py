@@ -44,6 +44,17 @@ comments. Three passes turn them into checked invariants:
     annotations. The dynamic leg is tools/nativecheck.py --sanitize
     (ASan+UBSan sidecar builds replaying the golden/fuzz corpora).
 
+  - `vsrlint` / `quorum` / `protomodel` (tidy/vsrlint.py,
+    tidy/protomodel.py) — the VSR protocol domain: handler
+    exhaustiveness over the Command enum, wire-taint from inbound
+    header fields into replica state, monotonicity proofs for
+    view/op/commit positions (`# tidy: monotonic=` sanctioned bumps),
+    the exhaustive quorum-intersection arithmetic for every cluster
+    size, and a bounded explicit-state model check of the abstract
+    view-change/commit transition system (smoke scope here; the full
+    sweep and the live-cluster conformance adapter run in
+    tests/test_protomodel.py).
+
 Findings are suppressed either inline (`# tidy: allow=<code> <reason>`)
 or via the checked-in baseline (baseline.json) so existing intentional
 patterns are explicit, not silence. `tidy/runtime.py` adds the fourth,
@@ -72,32 +83,19 @@ def all_pass_names():
         "ownership", "determinism", "markers",
         "host-sync", "retrace", "reduction", "absint",
         "native-layout", "native-abi", "native-absint",
+        "vsrlint", "quorum", "protomodel",
     )
 
 
-def run_passes(root=None, passes=None):
-    """Run the selected static passes (default: all) over the repo rooted
-    at `root` (default: the checkout containing this package). Returns a
-    list of Finding, sorted by (file, line)."""
-    import pathlib
+# The device hot-path lints (PR 5: hidden host syncs, retrace hazards,
+# nondeterministic reductions) share one module analysis — parse/hot-
+# set/taint run once however many of the trio are selected — so they
+# form a single work unit for timing/parallelism purposes.
+_JAX_TRIO = ("host-sync", "retrace", "reduction")
 
-    from tigerbeetle_tpu.tidy import (
-        absint, determinism, jaxlint, markers, nativecheck, ownership,
-    )
 
-    if root is None:
-        root = pathlib.Path(__file__).resolve().parents[2]
-    root = pathlib.Path(root)
-    all_passes = {
-        "ownership": ownership.run,
-        "determinism": determinism.run,
-        "markers": markers.run,
-        "absint": absint.run,
-        "native-layout": nativecheck.run_layout,
-        "native-abi": nativecheck.run_abi,
-        "native-absint": nativecheck.run_absint,
-    }
-    selected = passes if passes is not None else list(all_pass_names())
+def _expand_selection(passes):
+    selected = list(passes) if passes is not None else list(all_pass_names())
     # `native` expands to the whole C-boundary domain (check.py --passes
     # native runs all three, mirroring how the jaxlint trio groups).
     if "native" in selected:
@@ -111,18 +109,100 @@ def run_passes(root=None, passes=None):
         raise ValueError(
             f"unknown tidy pass(es) {unknown!r}; known: {all_pass_names()}"
         )
-    findings = []
-    # The device hot-path lints (PR 5: hidden host syncs, retrace
-    # hazards, nondeterministic reductions) share one module analysis —
-    # parse/hot-set/taint run once however many of the trio are
-    # selected. absint (the limb-width interval proofs) and the PR-4
-    # passes ride the same findings/baseline skeleton.
-    jax_selected = [p for p in selected
-                    if p in ("host-sync", "retrace", "reduction")]
-    if jax_selected:
-        findings.extend(jaxlint.run_selected(root, jax_selected))
+    return selected
+
+
+def _work_units(selected):
+    """Independent executable units in deterministic order: the jaxlint
+    trio runs as one unit, every other pass as its own."""
+    units = []
+    jax = tuple(p for p in selected if p in _JAX_TRIO)
+    if jax:
+        units.append(("jaxlint[" + ",".join(jax) + "]", ("jax", jax)))
     for name in selected:
-        if name in all_passes:
-            findings.extend(all_passes[name](root))
+        if name not in _JAX_TRIO:
+            units.append((name, ("pass", name)))
+    return units
+
+
+def _run_unit(root_str, unit):
+    """One work unit -> (findings, wall seconds). Module-level so a
+    process pool can pickle it (Finding is a plain dataclass)."""
+    import pathlib
+    import time
+
+    from tigerbeetle_tpu.tidy import (
+        absint, determinism, jaxlint, markers, nativecheck, ownership,
+        protomodel, vsrlint,
+    )
+
+    root = pathlib.Path(root_str)
+    t0 = time.perf_counter()
+    kind, payload = unit
+    if kind == "jax":
+        findings = jaxlint.run_selected(root, list(payload))
+    else:
+        table = {
+            "ownership": ownership.run,
+            "determinism": determinism.run,
+            "markers": markers.run,
+            "absint": absint.run,
+            "native-layout": nativecheck.run_layout,
+            "native-abi": nativecheck.run_abi,
+            "native-absint": nativecheck.run_absint,
+            "vsrlint": vsrlint.run,
+            "quorum": vsrlint.run_quorum,
+            "protomodel": protomodel.run,
+        }
+        findings = table[payload](root)
+    return findings, time.perf_counter() - t0
+
+
+def run_passes_timed(root=None, passes=None, parallel=False):
+    """Run the selected static passes; returns (findings, timings, mode)
+    where timings maps work-unit name -> wall seconds and mode is
+    "parallel" or "serial".  Parallel mode uses a small process pool
+    (the passes are CPU-bound AST walks and a BFS — the GIL makes
+    threads useless here) and falls back to serial on any pool failure,
+    so a broken multiprocessing setup degrades to slow, never to
+    unchecked."""
+    import pathlib
+
+    if root is None:
+        root = pathlib.Path(__file__).resolve().parents[2]
+    root = pathlib.Path(root)
+    units = _work_units(_expand_selection(passes))
+    findings, timings = [], {}
+    mode = "serial"
+    if parallel and len(units) > 1:
+        try:
+            import concurrent.futures as cf
+
+            with cf.ProcessPoolExecutor(max_workers=2) as ex:
+                futs = {
+                    ex.submit(_run_unit, str(root), unit): name
+                    for name, unit in units
+                }
+                for fut in cf.as_completed(futs):
+                    fs, dt = fut.result()
+                    findings.extend(fs)
+                    timings[futs[fut]] = dt
+            mode = "parallel"
+        except Exception:  # noqa: BLE001 — degrade to serial, never skip
+            findings, timings = [], {}
+            mode = "serial"
+    if mode == "serial":
+        for name, unit in units:
+            fs, dt = _run_unit(str(root), unit)
+            findings.extend(fs)
+            timings[name] = dt
     findings.sort(key=lambda f: (f.file, f.line, f.code))
+    return findings, timings, mode
+
+
+def run_passes(root=None, passes=None):
+    """Run the selected static passes (default: all) over the repo rooted
+    at `root` (default: the checkout containing this package). Returns a
+    list of Finding, sorted by (file, line)."""
+    findings, _timings, _mode = run_passes_timed(root, passes)
     return findings

@@ -30,10 +30,13 @@ PREFIX = "tidy:"
 # name) being waived on that line; everything else declares structure.
 # `static` (jaxlint) names parameters that are trace-time constants (the
 # special value `return` declares the function's RESULT static); `range`
-# (absint) declares entry intervals: `range=name:lo..hi,other:lo..hi`.
+# (absint) declares entry intervals: `range=name:lo..hi,other:lo..hi`;
+# `monotonic` (vsrlint) sanctions an assignment to a monotone protocol
+# field the prover cannot discharge: `monotonic=view — reason` on the
+# line (or on a def, blessing the whole bump helper).
 KNOWN_KEYS = frozenset((
     "owner", "guarded-by", "atomic", "thread", "holds", "allow", "barrier",
-    "init", "static", "range",
+    "init", "static", "range", "monotonic",
 ))
 
 

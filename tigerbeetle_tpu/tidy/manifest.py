@@ -202,6 +202,100 @@ NATIVE_ABSINT_FUNCS = (
 # (native call sites live in the package and the tools).
 NATIVE_LIFETIME_SCAN_DIRS = ("tigerbeetle_tpu", "tools")
 
+# --- vsrlint: VSR protocol lint scope ------------------------------------
+
+# Modules the protocol lints analyze (the consensus-critical layer: the
+# replica state machine, the WAL journal, the durable superblock, and
+# the wire ingress). Like every other domain, scope is a declaration.
+VSRLINT_MODULES = (
+    "tigerbeetle_tpu/vsr/replica.py",
+    "tigerbeetle_tpu/vsr/journal.py",
+    "tigerbeetle_tpu/vsr/superblock.py",
+    "tigerbeetle_tpu/net/bus.py",
+)
+
+# Where the Command enum and the replica dispatch table live (the
+# handler-exhaustiveness rule parses both, no runtime import).
+VSRLINT_COMMAND_MODULE = "tigerbeetle_tpu/vsr/header.py"
+VSRLINT_DISPATCH = ("tigerbeetle_tpu/vsr/replica.py", "on_message")
+
+# Command members that deliberately have NO replica dispatch handler.
+# Every entry carries the reason (where the command IS handled); an
+# exempted command that grows a handler becomes a stale-exemption
+# finding, so this table cannot rot.
+VSRLINT_COMMAND_EXEMPT = {
+    "RESERVED":
+        "command 0 is the invalid-frame sentinel — the codec and "
+        "Header.verify reject it before dispatch, it never reaches "
+        "on_message",
+    "PING_CLIENT":
+        "answered at the bus ingress (net/bus.py ReplicaServer pre-"
+        "dispatch fast path) — client pings never reach the replica "
+        "state machine",
+    "PONG_CLIENT":
+        "client-bound: emitted by ReplicaServer in answer to "
+        "PING_CLIENT, consumed by client.py — a replica never receives "
+        "one",
+    "REPLY":
+        "client-bound: produced by the commit path (ReplyBuilder), "
+        "consumed by client.py and testing SimClient — replicas route "
+        "it outward, never inward",
+    "EVICTION":
+        "client-bound session eviction, consumed by client.py / "
+        "SimClient",
+    "BUSY":
+        "client-bound admission shed, consumed by client.py / "
+        "SimClient",
+}
+
+# Inbound header fields the wire-taint rule treats as attacker-tainted
+# until they pass a validation guard (comparison / bounds check / MAC
+# verify) inside the handler.
+VSRLINT_WIRE_FIELDS = frozenset((
+    "view", "op", "commit", "commit_min", "commit_max", "op_checkpoint",
+    "checksum", "parent", "client", "request", "replica", "timestamp",
+    "operation", "context", "size", "session", "epoch",
+))
+
+# Replica/journal/superblock state attributes that constitute protocol
+# state: a wire-tainted value must be validated before being assigned
+# into any of these.
+VSRLINT_STATE_FIELDS = frozenset((
+    "view", "log_view", "op", "commit_min", "commit_max", "status",
+    "op_checkpoint", "checksum_floor", "timestamp_max", "view_durable",
+))
+
+# Fields whose assignments must be PROVEN non-decreasing (max() form,
+# guarded adoption, positive increment) or carry an explicit
+# `# tidy: monotonic=<field> — reason` annotation (the sanctioned-bump
+# discipline, same shape as absint's `range=`). `op` is here although it
+# legitimately decreases on view-change truncation — exactly those two
+# sites carry the annotation with the truncation proof.
+VSRLINT_MONOTONIC_FIELDS = frozenset((
+    "view", "log_view", "op", "commit_min", "commit_max",
+    "op_checkpoint", "checksum_floor", "timestamp_max", "sequence",
+    "config_epoch",
+))
+
+# Functions that ESTABLISH state rather than advance it: constructors
+# and the disk-image formatter. Monotonicity applies to the running
+# replica; recovery paths that re-load durable state annotate instead
+# (the annotation carries the durability argument).
+# Boot-path functions rebuild in-memory protocol state from durable
+# storage: monotonicity is a WITHIN-boot invariant (the conformance
+# checker in tidy/protomodel.py enforces exactly the same per-boot
+# semantics at runtime), so these reset/reload sites are sanctioned
+# wholesale rather than annotated line by line.
+VSRLINT_MONOTONIC_INIT_FUNCS = frozenset(
+    ("__init__", "format", "open", "recover")
+)
+
+# Cluster-size range the quorum-arithmetic pass exhaustively evaluates
+# (reference constants.zig replicas_max) and the standby counts it
+# proves irrelevant to quorum sizes.
+VSRLINT_QUORUM_REPLICA_RANGE = (1, 6)
+VSRLINT_QUORUM_STANDBY_RANGE = (0, 6)
+
 # --- marker scan scope ---------------------------------------------------
 
 # Directories / top-level scripts covered by the banned-marker scan.

@@ -614,7 +614,7 @@ class Replica:
             tracer.count("mark.open_replay_fault")
             self._begin_grid_repair(fault)
             return False
-        self.commit_min = op
+        self.commit_min = op  # tidy: monotonic=commit_min — boot replay walks contiguously upward from op_checkpoint
         try:
             self._finish_commit()
         except GridReadFault as fault:
@@ -1383,7 +1383,7 @@ class Replica:
                 self.pipeline.insert(0, entry)
                 self._begin_grid_repair(fault)
                 break
-            self.commit_min = op
+            self.commit_min = op  # tidy: monotonic=commit_min — inline commit loop pops the pipeline in op order from commit_min+1
             tracer.op_stamp(lc, tracer.OP_EXEC_END)
             if reply is not None:
                 # Reply first: it depends only on validate+post, and
@@ -1955,7 +1955,7 @@ class Replica:
             # everything staged behind it) and repair the block.
             self._stage_reclaim(job, fault)
             return
-        self.commit_min = op
+        self.commit_min = op  # tidy: monotonic=commit_min — staged completions apply in submission (op) order
         self._drop_target(op)
         spec = job.get("spec")
         reply = job.get("reply")
@@ -2332,6 +2332,17 @@ class Replica:
         # The install replaces the state machine wholesale: the executor
         # must not be mid-op against the old one.
         self._quiesce_commit_stage()
+        # Draining the stage applies queued completions, so commit_min
+        # (and, through a checkpoint landing inside the drain, even the
+        # durable op_checkpoint) may have advanced PAST this blob while
+        # we quiesced: the arrival-time freshness check in
+        # on_sync_checkpoint no longer holds. Installing now would
+        # regress commit_min/checksum_floor and re-point the superblock
+        # at an older checkpoint — abandon instead, exactly like the
+        # caught-up-via-WAL-repair path in _tick_sync.
+        if sync_op <= max(self.commit_min, self.superblock.state.op_checkpoint):
+            tracer.count("recovery.sync_stale_abandon")
+            return
         if self.store_executor is not None:
             # Queued store jobs write state the installed checkpoint
             # already covers wholesale: discard them (and any parked
@@ -2394,7 +2405,7 @@ class Replica:
         if wanted:
             install_free.free[np.array(sorted(wanted), dtype=np.int64)] = False
         self.commit_min = sync_op
-        self.checksum_floor = sync_op
+        self.checksum_floor = sync_op  # tidy: monotonic=checksum_floor — covered by the post-quiesce sync_op freshness re-check (checksum_floor == op_checkpoint <= commit_min < sync_op)
         self.op = max(self.op, sync_op)
         st = self.superblock.state
         st.op_checkpoint = sync_op
@@ -2731,7 +2742,7 @@ class Replica:
         # are committed and the DVC below advertises commit_min.
         self._quiesce_commit_stage()
         if self.status == STATUS_NORMAL:
-            self.log_view = self.view
+            self.log_view = self.view  # tidy: monotonic=log_view — normal status already has log_view == view (freeze at view-change entry, not a bump)
         log.info("replica %d: view_change -> view %d", self.replica, new_view)
         tracer.count("mark.view_change_enter")
         # View-change episode t0: a mid-change view bump (flap, dueling
@@ -2930,7 +2941,7 @@ class Replica:
 
         # Become primary of the new view.
         self.status = STATUS_NORMAL
-        self.log_view = v
+        self.log_view = v  # tidy: monotonic=log_view — v == self.view here (DVC quorum for the view we campaign in) and log_view <= view always
         self.pipeline = []
         self.peer_stats.close_all()  # fresh peer windows for the new view
         self.request_queue = deque()
@@ -3067,7 +3078,7 @@ class Replica:
             self._vc_dvc_t = None
         tracer.count("vsr.view_change.adopted")
         self.view = v
-        self.log_view = v
+        self.log_view = v  # tidy: monotonic=log_view — on_start_view validated v >= self.view >= log_view before adopting
         self.status = STATUS_NORMAL
         # A deposed primary lands here directly (catch-up without a
         # local view_change episode): close its stale peer windows.
@@ -3083,7 +3094,7 @@ class Replica:
         new_op = h["op"]
         if self.op > new_op:
             self.journal.truncate(new_op)
-        self.op = max(new_op, self.commit_min)
+        self.op = max(new_op, self.commit_min)  # tidy: monotonic=op — THE sanctioned regression: view-change suffix truncation to the elected log, clamped at commit_min (protomodel models this as deliver_sv log adoption)
         primary = h["replica"]
         targets: Dict[int, Header] = {}
         for sh in _parse_headers(msg.body):

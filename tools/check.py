@@ -101,14 +101,19 @@ def _pass_names():
     return tidy.all_pass_names()
 
 
-def check(root=None, passes=None, baseline_file=None) -> dict:
+def check(root=None, passes=None, baseline_file=None, parallel=True) -> dict:
     """Run passes + baseline split; returns the full report dict (the
-    pytest entry and --json consume this directly)."""
+    pytest entry and --json consume this directly). Independent passes
+    run on a 2-worker process pool by default (time budget: the full
+    13-pass run must stay under ~60 s on the 2-core container —
+    tests/test_check_contract.py and docs/STATIC_ANALYSIS.md pin it)."""
     from tigerbeetle_tpu import tidy
     from tigerbeetle_tpu.tidy.findings import load_baseline, split_by_baseline
 
     root = pathlib.Path(root) if root is not None else REPO
-    findings = tidy.run_passes(root, passes)
+    findings, timings, mode = tidy.run_passes_timed(
+        root, passes, parallel=parallel
+    )
     baseline = load_baseline(baseline_file)
     new, suppressed, stale = split_by_baseline(findings, baseline)
     return {
@@ -119,6 +124,8 @@ def check(root=None, passes=None, baseline_file=None) -> dict:
         "suppressed": [f.to_dict() for f in suppressed],
         "stale_baseline_keys": stale,
         "ok": not new,
+        "timings": {k: round(v, 3) for k, v in timings.items()},
+        "parallel": mode == "parallel",
     }
 
 
@@ -135,6 +142,15 @@ def main(argv=None) -> int:
              "native-layout native-abi native-absint)",
     )
     ap.add_argument("--baseline", default=None, help="baseline file override")
+    ap.add_argument(
+        "--timings", action="store_true",
+        help="per-pass wall-clock report (budget: full run <= ~60 s on "
+             "2 cores; the timings ride the --json report unconditionally)",
+    )
+    ap.add_argument(
+        "--serial", action="store_true",
+        help="disable the 2-worker process pool (debugging aid)",
+    )
     ap.add_argument(
         "--write-baseline", action="store_true",
         help="accept every current finding into the baseline and exit 0",
@@ -164,7 +180,8 @@ def main(argv=None) -> int:
         print(f"baseline: {len(findings)} finding(s) accepted")
         return 0
 
-    report = check(args.root, args.passes, args.baseline)
+    report = check(args.root, args.passes, args.baseline,
+                   parallel=not args.serial)
     # Eighth pass — perf-trajectory change points (advisory unless
     # --strict-new): only against THIS repo's series (a --root override
     # analyzes someone else's tree; their devhub history is not ours).
@@ -192,6 +209,15 @@ def main(argv=None) -> int:
                   f"{f['scope']}: {f['subject']}")
         for k in report["stale_baseline_keys"]:
             print(f"stale baseline entry: {k}")
+        if args.timings:
+            total = sum(report["timings"].values())
+            mode = "parallel" if report["parallel"] else "serial"
+            for name, dt in sorted(
+                report["timings"].items(), key=lambda kv: -kv[1]
+            ):
+                print(f"timing {dt:7.3f}s  {name}")
+            print(f"timing {total:7.3f}s  total pass work ({mode}; "
+                  f"budget ~60s wall on 2 cores)")
         mode = "strict" if args.strict_new else "advisory"
         for f in devhub_report["failures"]:
             print(f"devhub ({mode}): {f}")
